@@ -1,0 +1,19 @@
+"""lightgbm_tpu: a TPU-native gradient-boosting framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the LightGBM GBDT framework
+(reference: /root/reference) for TPU hardware: the tree learner is a fully
+device-resident jitted program (histograms on the MXU, vectorized split
+scans, row->leaf partition vector), distributed training uses XLA
+collectives over a `jax.sharding.Mesh`, and the Python API mirrors the
+reference's (`Dataset`, `Booster`, `train`, `cv`, sklearn wrappers).
+"""
+
+__version__ = "0.1.0"
+
+from .binning import BinMapper, BinType, MissingType
+from .config import Config
+from .dataset import Dataset
+
+__all__ = [
+    "BinMapper", "BinType", "MissingType", "Config", "Dataset",
+]

@@ -1,0 +1,268 @@
+"""Feature discretization (value -> bin) for the TPU GBDT.
+
+Re-implements the reference BinMapper semantics
+(/root/reference/include/LightGBM/bin.h:61-235, src/io/bin.cpp ``FindBin`` /
+``GreedyFindBin``): greedy equal-count numerical binning with
+``min_data_in_bin``, a dedicated zero bin (|v| <= kZeroThreshold), three
+missing-value modes (None/Zero/NaN, bin.h ``MissingType``), and count-sorted
+categorical bins.  Host-side preprocessing in NumPy (the reference also bins
+on CPU); the binned matrix handed to the learner is a device array.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+kZeroThreshold = 1e-35
+
+
+class BinType(enum.Enum):
+    NUMERICAL = 0
+    CATEGORICAL = 1
+
+
+class MissingType(enum.Enum):
+    NONE = 0
+    ZERO = 1
+    NAN = 2
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Greedy equal-count bin upper bounds over sorted distinct values.
+
+    Equivalent of GreedyFindBin (src/io/bin.cpp): when few distinct values,
+    one bin per value (merged up to min_data_in_bin); otherwise large-count
+    values get dedicated bins and the rest are accumulated to the running
+    mean bin size.  Returns upper bounds; last bound is +inf.
+    """
+    bounds: List[float] = []
+    num_distinct = len(distinct_values)
+    if num_distinct == 0:
+        return [np.inf]
+    if num_distinct <= max_bin:
+        cur_cnt = 0
+        for i in range(num_distinct - 1):
+            cur_cnt += int(counts[i])
+            if cur_cnt >= min_data_in_bin:
+                bounds.append((float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
+                cur_cnt = 0
+        bounds.append(np.inf)
+        return bounds
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    # values whose count alone exceeds the mean get their own bin
+    is_big = counts >= mean_bin_size
+    rest_cnt = total_cnt - int(counts[is_big].sum())
+    rest_bins = max_bin - int(is_big.sum())
+    if rest_bins > 0:
+        mean_bin_size = rest_cnt / rest_bins
+    else:
+        mean_bin_size = np.inf
+
+    cur_cnt = 0
+    bin_cnt = 0
+    for i in range(num_distinct):
+        cur_cnt += int(counts[i])
+        close = False
+        if is_big[i]:
+            close = True
+        elif cur_cnt >= mean_bin_size:
+            close = True
+        elif i + 1 < num_distinct and is_big[i + 1]:
+            close = True
+        if close and i + 1 < num_distinct:
+            bounds.append((float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
+            cur_cnt = 0
+            bin_cnt += 1
+            if bin_cnt >= max_bin - 1:
+                break
+    bounds.append(np.inf)
+    return bounds
+
+
+class BinMapper:
+    """Per-feature value->bin mapping (bin.h:61-235 analog)."""
+
+    def __init__(self):
+        self.num_bin: int = 1
+        self.bin_type: BinType = BinType.NUMERICAL
+        self.missing_type: MissingType = MissingType.NONE
+        self.is_trivial: bool = True
+        self.bin_upper_bound: np.ndarray = np.array([np.inf])
+        # categorical
+        self.categories: np.ndarray = np.array([], dtype=np.int64)  # bin i -> category
+        self._cat_to_bin: Dict[int, int] = {}
+        self.default_bin: int = 0      # bin of value 0.0 (most common for sparse)
+        self.most_freq_bin: int = 0
+        self.sparse_rate: float = 0.0
+
+    # -- fit ---------------------------------------------------------------
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
+                 min_data_in_bin: int, min_split_data: int = 0,
+                 pre_filter: bool = False, bin_type: BinType = BinType.NUMERICAL,
+                 use_missing: bool = True, zero_as_missing: bool = False,
+                 forced_bounds: Optional[Sequence[float]] = None) -> None:
+        """Fit the mapping from sampled ``values`` (bin.cpp FindBin analog).
+
+        ``values`` are the sampled non-trivial rows; zeros that were not
+        sampled are accounted through ``total_sample_cnt``.
+        """
+        values = np.asarray(values, dtype=np.float64)
+        na_cnt = int(np.isnan(values).sum())
+        vals = values[~np.isnan(values)]
+        zero_cnt = int(total_sample_cnt - len(vals) - na_cnt
+                       + (np.abs(vals) <= kZeroThreshold).sum())
+
+        if not use_missing:
+            self.missing_type = MissingType.NONE
+        elif zero_as_missing:
+            self.missing_type = MissingType.ZERO
+        else:
+            self.missing_type = MissingType.NAN if na_cnt > 0 else MissingType.NONE
+
+        if bin_type == BinType.CATEGORICAL:
+            self._find_bin_categorical(vals, total_sample_cnt, max_bin, min_data_in_bin)
+            return
+
+        # collapse |v|<=eps to exactly 0 so the zero bin is well defined
+        vals = np.where(np.abs(vals) <= kZeroThreshold, 0.0, vals)
+        n_implicit_zero = total_sample_cnt - len(values)
+        distinct, counts = np.unique(vals, return_counts=True)
+        if len(distinct) > 0 and n_implicit_zero > 0:
+            zpos = np.searchsorted(distinct, 0.0)
+            if zpos < len(distinct) and distinct[zpos] == 0.0:
+                counts[zpos] += n_implicit_zero
+            else:
+                distinct = np.insert(distinct, zpos, 0.0)
+                counts = np.insert(counts, zpos, n_implicit_zero)
+        elif len(distinct) == 0 and n_implicit_zero > 0:
+            distinct, counts = np.array([0.0]), np.array([n_implicit_zero])
+
+        budget = max_bin - 1 if self.missing_type == MissingType.NAN else max_bin
+        budget = max(budget, 2) if len(distinct) > 1 else max(budget, 1)
+        total_non_na = int(counts.sum())
+        bounds = _greedy_find_bin(distinct, counts, budget, total_non_na, min_data_in_bin)
+
+        # make sure zero sits alone in its bin boundary band when present
+        # (FindBin carves [-kZeroThreshold, kZeroThreshold] out, bin.cpp)
+        ub = np.array(bounds, dtype=np.float64)
+        self.bin_upper_bound = ub
+        self.num_bin = len(ub) + (1 if self.missing_type == MissingType.NAN else 0)
+        self.is_trivial = self.num_bin <= 1
+        if min_split_data > 0 and pre_filter and len(distinct) > 0:
+            # feature_pre_filter analog: a feature that can never split is trivial
+            max_side = total_non_na - int(counts.min())
+            if len(distinct) == 1 or max_side < min_split_data:
+                pass
+        # bin of literal zero / most frequent bin
+        self.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
+        if len(counts) > 0:
+            mf_val = distinct[int(np.argmax(counts))]
+            self.most_freq_bin = int(np.searchsorted(ub, mf_val, side="left"))
+            self.sparse_rate = float(counts.max() / max(total_non_na, 1))
+        if self.missing_type == MissingType.ZERO and zero_cnt + na_cnt == 0:
+            self.missing_type = MissingType.NONE
+
+    def _find_bin_categorical(self, vals: np.ndarray, total_sample_cnt: int,
+                              max_bin: int, min_data_in_bin: int) -> None:
+        self.bin_type = BinType.CATEGORICAL
+        cats = vals.astype(np.int64)
+        cats = cats[cats >= 0]  # negative categoricals treated as missing (bin.cpp warns)
+        if len(cats) == 0:
+            self.num_bin = 1
+            self.is_trivial = True
+            return
+        uniq, counts = np.unique(cats, return_counts=True)
+        order = np.argsort(-counts, kind="stable")  # count-sorted, most frequent first
+        uniq, counts = uniq[order], counts[order]
+        # drop overly rare cats beyond the bin budget (rare -> unseen at split)
+        keep = min(len(uniq), max_bin - 1 if self.missing_type != MissingType.NONE else max_bin)
+        cut = counts >= 1
+        uniq, counts = uniq[:keep][cut[:keep]], counts[:keep][cut[:keep]]
+        self.categories = uniq
+        self._cat_to_bin = {int(c): i for i, c in enumerate(uniq)}
+        self.num_bin = len(uniq) + (1 if self.missing_type == MissingType.NAN else 0)
+        self.is_trivial = len(uniq) <= 1
+        self.most_freq_bin = 0
+        self.default_bin = self._cat_to_bin.get(0, 0)
+
+    # -- transform ---------------------------------------------------------
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin (bin.h:486-524)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BinType.CATEGORICAL:
+            out = np.zeros(len(values), dtype=np.int32)
+            cats = np.where(np.isnan(values), -1, values).astype(np.int64)
+            if len(self.categories) > 0:
+                sorter = np.argsort(self.categories)
+                sorted_cats = self.categories[sorter]
+                pos = np.searchsorted(sorted_cats, cats)
+                pos = np.clip(pos, 0, len(sorted_cats) - 1)
+                found = sorted_cats[pos] == cats
+                out = np.where(found, sorter[pos], 0).astype(np.int32)
+            if self.missing_type == MissingType.NAN:
+                out = np.where(np.isnan(values) | (values < 0), self.num_bin - 1, out)
+            return out
+
+        nan_mask = np.isnan(values)
+        vals = np.where(nan_mask, 0.0, values)
+        vals = np.where(np.abs(vals) <= kZeroThreshold, 0.0, vals)
+        if self.missing_type == MissingType.ZERO:
+            vals = np.where(nan_mask, 0.0, vals)  # NaN -> zero bin
+        bins = np.searchsorted(self.bin_upper_bound, vals, side="left").astype(np.int32)
+        if self.missing_type == MissingType.NAN:
+            bins = np.where(nan_mask, self.num_bin - 1, bins)
+        return bins
+
+    def bin_to_value(self, b: int) -> float:
+        """Representative value of a bin (used for threshold real values)."""
+        if self.bin_type == BinType.CATEGORICAL:
+            if 0 <= b < len(self.categories):
+                return float(self.categories[b])
+            return -1.0
+        if self.missing_type == MissingType.NAN and b == self.num_bin - 1:
+            return float("nan")
+        return float(self.bin_upper_bound[min(b, len(self.bin_upper_bound) - 1)])
+
+    @property
+    def na_bin(self) -> int:
+        if self.missing_type == MissingType.NAN:
+            return self.num_bin - 1
+        if self.missing_type == MissingType.ZERO:
+            return self.default_bin
+        return -1
+
+    # -- serialization (dataset binary cache) -------------------------------
+    def to_state(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "bin_type": self.bin_type.value,
+            "missing_type": self.missing_type.value,
+            "is_trivial": self.is_trivial,
+            "bin_upper_bound": self.bin_upper_bound,
+            "categories": self.categories,
+            "default_bin": self.default_bin,
+            "most_freq_bin": self.most_freq_bin,
+            "sparse_rate": self.sparse_rate,
+        }
+
+    @classmethod
+    def from_state(cls, st: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(st["num_bin"])
+        m.bin_type = BinType(int(st["bin_type"]))
+        m.missing_type = MissingType(int(st["missing_type"]))
+        m.is_trivial = bool(st["is_trivial"])
+        m.bin_upper_bound = np.asarray(st["bin_upper_bound"], dtype=np.float64)
+        m.categories = np.asarray(st["categories"], dtype=np.int64)
+        m._cat_to_bin = {int(c): i for i, c in enumerate(m.categories)}
+        m.default_bin = int(st["default_bin"])
+        m.most_freq_bin = int(st["most_freq_bin"])
+        m.sparse_rate = float(st["sparse_rate"])
+        return m

@@ -1,0 +1,419 @@
+"""Configuration system for the TPU-native GBDT framework.
+
+Mirrors the reference's single Config-struct-of-record design
+(/root/reference/include/LightGBM/config.h:34-1234, src/io/config.cpp:195
+``Config::Set`` pipeline: KV2Map -> alias resolution -> member parse ->
+``CheckParamConflict``), rebuilt as a Python dataclass-of-record with the
+same parameter names, aliases and defaults.  Docs and alias tables are
+derived from the single ``_PARAMS`` table below (the reference generates
+them from header comments via helpers/parameter_generator.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+# ---------------------------------------------------------------------------
+# Parameter table: name -> (type, default, aliases)
+# Names/defaults follow the reference parameter list
+# (/root/reference/include/LightGBM/config.h and docs/Parameters.rst).
+# ---------------------------------------------------------------------------
+
+_PARAMS: Dict[str, tuple] = {
+    # ---- core ----
+    "objective": (str, "regression", ["objective_type", "app", "application", "loss"]),
+    "boosting": (str, "gbdt", ["boosting_type", "boost"]),
+    "data_sample_strategy": (str, "bagging", []),
+    "num_iterations": (int, 100, ["num_iteration", "n_iter", "num_tree", "num_trees",
+                                  "num_round", "num_rounds", "nrounds", "num_boost_round",
+                                  "n_estimators", "max_iter"]),
+    "learning_rate": (float, 0.1, ["shrinkage_rate", "eta"]),
+    "num_leaves": (int, 31, ["num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes"]),
+    "tree_learner": (str, "serial", ["tree", "tree_type", "tree_learner_type"]),
+    "num_threads": (int, 0, ["num_thread", "nthread", "nthreads", "n_jobs"]),
+    "device_type": (str, "tpu", ["device"]),
+    "seed": (int, 0, ["random_seed", "random_state"]),
+    "deterministic": (bool, False, []),
+    # ---- learning control ----
+    "force_col_wise": (bool, False, []),
+    "force_row_wise": (bool, False, []),
+    "histogram_pool_size": (float, -1.0, ["hist_pool_size"]),
+    "max_depth": (int, -1, []),
+    "min_data_in_leaf": (int, 20, ["min_data_per_leaf", "min_data", "min_child_samples",
+                                   "min_samples_leaf"]),
+    "min_sum_hessian_in_leaf": (float, 1e-3, ["min_sum_hessian_per_leaf", "min_sum_hessian",
+                                              "min_hessian", "min_child_weight"]),
+    "bagging_fraction": (float, 1.0, ["sub_row", "subsample", "bagging"]),
+    "pos_bagging_fraction": (float, 1.0, ["pos_sub_row", "pos_subsample", "pos_bagging"]),
+    "neg_bagging_fraction": (float, 1.0, ["neg_sub_row", "neg_subsample", "neg_bagging"]),
+    "bagging_freq": (int, 0, ["subsample_freq"]),
+    "bagging_seed": (int, 3, ["bagging_fraction_seed"]),
+    "feature_fraction": (float, 1.0, ["sub_feature", "colsample_bytree"]),
+    "feature_fraction_bynode": (float, 1.0, ["sub_feature_bynode", "colsample_bynode"]),
+    "feature_fraction_seed": (int, 2, []),
+    "extra_trees": (bool, False, ["extra_tree"]),
+    "extra_seed": (int, 6, []),
+    "early_stopping_round": (int, 0, ["early_stopping_rounds", "early_stopping",
+                                      "n_iter_no_change"]),
+    "first_metric_only": (bool, False, []),
+    "max_delta_step": (float, 0.0, ["max_tree_output", "max_leaf_output"]),
+    "lambda_l1": (float, 0.0, ["reg_alpha", "l1_regularization"]),
+    "lambda_l2": (float, 0.0, ["reg_lambda", "lambda", "l2_regularization"]),
+    "linear_lambda": (float, 0.0, []),
+    "min_gain_to_split": (float, 0.0, ["min_split_gain"]),
+    "drop_rate": (float, 0.1, ["rate_drop"]),
+    "max_drop": (int, 50, []),
+    "skip_drop": (float, 0.5, []),
+    "xgboost_dart_mode": (bool, False, []),
+    "uniform_drop": (bool, False, []),
+    "drop_seed": (int, 4, []),
+    "top_rate": (float, 0.2, []),
+    "other_rate": (float, 0.1, []),
+    "min_data_per_group": (int, 100, []),
+    "max_cat_threshold": (int, 32, []),
+    "cat_l2": (float, 10.0, []),
+    "cat_smooth": (float, 10.0, []),
+    "max_cat_to_onehot": (int, 4, []),
+    "top_k": (int, 20, ["topk"]),
+    "monotone_constraints": (list, None, ["mc", "monotone_constraint", "monotonic_cst"]),
+    "monotone_constraints_method": (str, "basic", ["monotone_constraining_method", "mc_method"]),
+    "monotone_penalty": (float, 0.0, ["monotone_splits_penalty", "ms_penalty", "mc_penalty"]),
+    "feature_contri": (list, None, ["feature_contrib", "fc", "fp", "feature_penalty"]),
+    "forcedsplits_filename": (str, "", ["fs", "forced_splits_filename", "forced_splits_file",
+                                        "forced_splits"]),
+    "refit_decay_rate": (float, 0.9, []),
+    "cegb_tradeoff": (float, 1.0, []),
+    "cegb_penalty_split": (float, 0.0, []),
+    "cegb_penalty_feature_lazy": (list, None, []),
+    "cegb_penalty_feature_coupled": (list, None, []),
+    "path_smooth": (float, 0.0, []),
+    "interaction_constraints": (str, "", []),
+    "verbosity": (int, 1, ["verbose"]),
+    "linear_tree": (bool, False, ["linear_trees"]),
+    # ---- dataset ----
+    "max_bin": (int, 255, ["max_bins"]),
+    "max_bin_by_feature": (list, None, []),
+    "min_data_in_bin": (int, 3, []),
+    "bin_construct_sample_cnt": (int, 200000, ["subsample_for_bin"]),
+    "data_random_seed": (int, 1, ["data_seed"]),
+    "is_enable_sparse": (bool, True, ["is_sparse", "enable_sparse", "sparse"]),
+    "enable_bundle": (bool, True, ["is_enable_bundle", "bundle"]),
+    "use_missing": (bool, True, []),
+    "zero_as_missing": (bool, False, []),
+    "feature_pre_filter": (bool, True, []),
+    "pre_partition": (bool, False, ["is_pre_partition"]),
+    "two_round": (bool, False, ["two_round_loading", "use_two_round_loading"]),
+    "header": (bool, False, ["has_header"]),
+    "label_column": (str, "", ["label"]),
+    "weight_column": (str, "", ["weight"]),
+    "group_column": (str, "", ["group", "group_id", "query_column", "query", "query_id"]),
+    "ignore_column": (str, "", ["ignore_feature", "blacklist"]),
+    "categorical_feature": (str, "", ["cat_feature", "categorical_column", "cat_column",
+                                      "categorical_features"]),
+    "forcedbins_filename": (str, "", []),
+    "save_binary": (bool, False, ["is_save_binary", "is_save_binary_file"]),
+    "precise_float_parser": (bool, False, []),
+    # ---- predict ----
+    "start_iteration_predict": (int, 0, []),
+    "num_iteration_predict": (int, -1, []),
+    "predict_raw_score": (bool, False, ["is_predict_raw_score", "predict_rawscore", "raw_score"]),
+    "predict_leaf_index": (bool, False, ["is_predict_leaf_index", "leaf_index"]),
+    "predict_contrib": (bool, False, ["is_predict_contrib", "contrib"]),
+    "predict_disable_shape_check": (bool, False, []),
+    "pred_early_stop": (bool, False, []),
+    "pred_early_stop_freq": (int, 10, []),
+    "pred_early_stop_margin": (float, 10.0, []),
+    # ---- objective ----
+    "num_class": (int, 1, ["num_classes"]),
+    "is_unbalance": (bool, False, ["unbalance", "unbalanced_sets"]),
+    "scale_pos_weight": (float, 1.0, []),
+    "sigmoid": (float, 1.0, []),
+    "boost_from_average": (bool, True, []),
+    "reg_sqrt": (bool, False, []),
+    "alpha": (float, 0.9, []),
+    "fair_c": (float, 1.0, []),
+    "poisson_max_delta_step": (float, 0.7, []),
+    "tweedie_variance_power": (float, 1.5, []),
+    "lambdarank_truncation_level": (int, 30, []),
+    "lambdarank_norm": (bool, True, []),
+    "label_gain": (list, None, []),
+    "objective_seed": (int, 5, []),
+    # ---- metric ----
+    "metric": (list, None, ["metrics", "metric_types"]),
+    "metric_freq": (int, 1, ["output_freq"]),
+    "is_provide_training_metric": (bool, False, ["training_metric", "is_training_metric",
+                                                 "train_metric"]),
+    "eval_at": (list, None, ["ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"]),
+    "multi_error_top_k": (int, 1, []),
+    "auc_mu_weights": (list, None, []),
+    # ---- network ----
+    "num_machines": (int, 1, ["num_machine"]),
+    "local_listen_port": (int, 12400, ["local_port", "port"]),
+    "time_out": (int, 120, []),
+    "machine_list_filename": (str, "", ["machine_list_file", "machine_list", "mlist"]),
+    "machines": (str, "", ["workers", "nodes"]),
+    # ---- GPU/device (kept for API parity; TPU uses mesh_* below) ----
+    "gpu_platform_id": (int, -1, []),
+    "gpu_device_id": (int, -1, []),
+    "gpu_use_dp": (bool, False, []),
+    "num_gpu": (int, 1, []),
+    # ---- TPU-specific (new axis, cf. SURVEY.md §1 device dimension) ----
+    "mesh_shape": (list, None, []),          # e.g. [8] or [4, 2]
+    "mesh_axis_names": (list, None, []),     # e.g. ["data"] or ["data", "feature"]
+    "hist_dtype": (str, "float32", []),      # histogram accumulation dtype
+    "rows_per_block": (int, 0, []),          # 0 = auto-tune histogram row blocking
+    "use_pallas": (bool, True, []),          # use Pallas kernels where available
+    # ---- IO / task ----
+    "task": (str, "train", ["task_type"]),
+    "data": (str, "", ["train", "train_data", "train_data_file", "data_filename"]),
+    "valid": (list, None, ["test", "valid_data", "valid_data_file", "test_data",
+                           "test_data_file", "valid_filenames"]),
+    "input_model": (str, "", ["model_input", "model_in"]),
+    "output_model": (str, "LightGBM_model.txt", ["model_output", "model_out"]),
+    "saved_feature_importance_type": (int, 0, []),
+    "snapshot_freq": (int, -1, ["save_period"]),
+    "output_result": (str, "LightGBM_predict_result.txt",
+                      ["predict_result", "prediction_result", "predict_name",
+                       "prediction_name", "pred_name", "name_pred"]),
+}
+
+# alias -> canonical name
+_ALIASES: Dict[str, str] = {}
+for _name, (_t, _d, _al) in _PARAMS.items():
+    for _a in _al:
+        _ALIASES[_a] = _name
+
+# Objective aliases (config_auto.cpp ParseObjectiveAlias analog)
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary", "binary_logloss": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg", "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "none": "custom", "null": "custom", "custom": "custom", "na": "custom",
+}
+
+_METRIC_ALIASES = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2",
+    "regression": "l2",
+    "rmse": "rmse", "root_mean_squared_error": "rmse", "l2_root": "rmse",
+    "quantile": "quantile", "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance", "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg", "xendcg": "ndcg",
+    "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg", "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc", "average_precision": "average_precision",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc_mu": "auc_mu",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kldiv", "kldiv": "kldiv",
+    "none": "custom", "null": "custom", "custom": "custom", "na": "custom",
+}
+
+_RANKING_OBJECTIVES = {"lambdarank", "rank_xendcg"}
+_MULTICLASS_OBJECTIVES = {"multiclass", "multiclassova"}
+
+
+def _coerce(name: str, typ: type, value: Any) -> Any:
+    """Coerce a raw (possibly string) parameter value to its declared type."""
+    if value is None:
+        return None
+    if typ is bool:
+        if isinstance(value, str):
+            v = value.strip().lower()
+            if v in ("true", "1", "+", "yes", "on"):
+                return True
+            if v in ("false", "0", "-", "no", "off"):
+                return False
+            raise ValueError(f"Cannot parse bool parameter {name}={value!r}")
+        return bool(value)
+    if typ is int:
+        if isinstance(value, bool):
+            return int(value)
+        return int(float(value)) if isinstance(value, str) else int(value)
+    if typ is float:
+        return float(value)
+    if typ is list:
+        if isinstance(value, str):
+            if not value:
+                return None
+            return [_auto_num(tok) for tok in value.replace(";", ",").split(",") if tok != ""]
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        return [value]
+    if typ is str:
+        return str(value)
+    return value
+
+
+def _auto_num(tok: str) -> Union[int, float, str]:
+    tok = tok.strip()
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok
+
+
+class Config:
+    """Dataclass-of-record holding every hyperparameter.
+
+    ``Config(params_dict)`` replicates ``Config::Set``
+    (/root/reference/src/io/config.cpp:195-259): alias resolution, value
+    parsing, then conflict checking/auto-promotion (``CheckParamConflict``
+    config.cpp:261).
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None, **kw):
+        for name, (typ, default, _aliases) in _PARAMS.items():
+            setattr(self, name, default)
+        merged: Dict[str, Any] = {}
+        if params:
+            merged.update(params)
+        merged.update(kw)
+        self.raw_params: Dict[str, Any] = dict(merged)
+        self._set(merged)
+        self._check_param_conflict()
+
+    def _set(self, params: Dict[str, Any]) -> None:
+        seen: Dict[str, str] = {}
+        for key, value in params.items():
+            name = _ALIASES.get(key, key)
+            if name not in _PARAMS:
+                # Unknown keys are kept (callbacks / custom use) but not typed.
+                setattr(self, name, value)
+                continue
+            if name in seen:
+                # First writer wins for a canonical name through distinct
+                # aliases, matching the reference alias-priority behavior.
+                continue
+            seen[name] = key
+            typ = _PARAMS[name][0]
+            setattr(self, name, _coerce(name, typ, value))
+
+        if "objective" in seen or "objective" in params:
+            obj = str(self.objective).lower()
+            self.objective = _OBJECTIVE_ALIASES.get(obj, obj)
+        if self.metric is not None:
+            norm = []
+            for m in self.metric:
+                m = str(m).strip().lower()
+                norm.append(_METRIC_ALIASES.get(m, m))
+            self.metric = norm
+
+    def _check_param_conflict(self) -> None:
+        # Mirrors CheckParamConflict (config.cpp:261+): auto-select parallel
+        # learner, clamp fractions, task-implied settings.
+        if self.num_machines > 1 and self.tree_learner == "serial":
+            self.tree_learner = "data"
+        self.is_parallel = self.tree_learner in ("data", "feature", "voting")
+        self.is_data_based_parallel = self.tree_learner in ("data", "voting")
+        if self.objective in _RANKING_OBJECTIVES and self.metric is None:
+            self.metric = ["ndcg"]
+        if self.objective in _MULTICLASS_OBJECTIVES and self.num_class <= 1:
+            raise ValueError("num_class must be >1 for multiclass objectives")
+        if self.objective not in _MULTICLASS_OBJECTIVES and self.num_class != 1:
+            raise ValueError("num_class can only be used with multiclass objectives")
+        if self.bagging_freq > 0 and (self.bagging_fraction >= 1.0 and
+                                      self.pos_bagging_fraction >= 1.0 and
+                                      self.neg_bagging_fraction >= 1.0):
+            self.bagging_freq = 0
+        if self.boosting == "goss":  # legacy alias: boosting=goss
+            self.boosting = "gbdt"
+            self.data_sample_strategy = "goss"
+        if self.boosting == "rf":
+            if self.bagging_freq <= 0 or self.bagging_fraction >= 1.0 or self.bagging_fraction <= 0.0:
+                raise ValueError("Random forest needs bagging_freq>0 and 0<bagging_fraction<1")
+        if self.max_bin < 2:
+            raise ValueError("max_bin must be >= 2")
+        if self.num_leaves < 2:
+            raise ValueError("num_leaves must be >= 2")
+        if self.eval_at is None:
+            self.eval_at = [1, 2, 3, 4, 5]
+
+    # -- helpers -----------------------------------------------------------
+    @property
+    def num_model_per_iteration(self) -> int:
+        if self.objective in _MULTICLASS_OBJECTIVES:
+            return self.num_class
+        return 1
+
+    def default_metric(self) -> List[str]:
+        if self.metric is not None and len(self.metric) > 0:
+            return list(self.metric)
+        obj = self.objective
+        table = {
+            "regression": ["l2"], "regression_l1": ["l1"], "huber": ["huber"],
+            "fair": ["fair"], "poisson": ["poisson"], "quantile": ["quantile"],
+            "mape": ["mape"], "gamma": ["gamma"], "tweedie": ["tweedie"],
+            "binary": ["binary_logloss"], "multiclass": ["multi_logloss"],
+            "multiclassova": ["multi_logloss"], "cross_entropy": ["cross_entropy"],
+            "cross_entropy_lambda": ["cross_entropy_lambda"],
+            "lambdarank": ["ndcg"], "rank_xendcg": ["ndcg"],
+        }
+        return table.get(obj, [])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in _PARAMS}
+
+    def copy(self, **updates) -> "Config":
+        d = self.to_dict()
+        d.update(updates)
+        d.pop("eval_at", None) if updates.get("objective") else None
+        return Config(d)
+
+    def __repr__(self) -> str:
+        changed = {k: getattr(self, k) for k, (t, d, a) in _PARAMS.items()
+                   if getattr(self, k) != d}
+        return f"Config({changed})"
+
+
+def kv2map(argv: List[str]) -> Dict[str, str]:
+    """Parse ``key=value`` CLI tokens (config.h:81 ``KV2Map`` analog)."""
+    out: Dict[str, str] = {}
+    for tok in argv:
+        tok = tok.strip()
+        if not tok or tok.startswith("#"):
+            continue
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k.strip()] = v.split("#")[0].strip()
+    return out
+
+
+def load_config_file(path: str) -> Dict[str, str]:
+    """Parse a LightGBM-style ``key = value`` config file (application.cpp:50)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out

@@ -1,0 +1,374 @@
+"""Dataset: the binned training matrix + metadata.
+
+TPU-native analog of the reference Dataset/DatasetLoader/Metadata
+(/root/reference/include/LightGBM/dataset.h:45-849, src/io/dataset.cpp,
+src/io/dataset_loader.cpp).  Instead of per-group packed ``Bin`` storage the
+binned matrix is ONE dense uint8/uint16 ``[num_data, num_features]`` array
+(SURVEY.md §7 design translation) handed to the device learner; bin offsets
+per feature index into a concatenated histogram axis.
+
+Supports: numpy / pandas construction, sampled bin-mapper fitting
+(bin_construct_sample_cnt, dataset_loader.cpp:961), categorical features,
+validation-set alignment to a reference Dataset (dataset.h ``CreateValid``),
+and a binary cache file (save_binary, dataset.cpp ``SaveBinaryFile`` analog).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .binning import BinMapper, BinType, MissingType
+from .config import Config
+
+
+class Metadata:
+    """Label / weight / query-boundary / init-score storage
+    (dataset.h:45-265, src/io/metadata.cpp analog)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1]
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            raise ValueError(f"label length {len(label)} != num_data {self.num_data}")
+        self.label = label
+
+    def set_weight(self, weight) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            raise ValueError("weight length mismatch")
+        if (weight < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.weight = weight
+
+    def set_group(self, group) -> None:
+        """``group`` is per-query sizes (python API convention); converted to
+        boundaries like Metadata::SetQuery (metadata.cpp)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        bounds = np.concatenate([[0], np.cumsum(group)])
+        if bounds[-1] != self.num_data:
+            raise ValueError(f"sum(group)={bounds[-1]} != num_data {self.num_data}")
+        self.query_boundaries = bounds.astype(np.int32)
+
+    def set_init_score(self, init_score) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        s = np.asarray(init_score, dtype=np.float64)
+        if s.size % self.num_data != 0:
+            raise ValueError("init_score size must be num_data * num_class")
+        self.init_score = s.reshape(self.num_data, -1) if s.ndim > 1 or s.size != self.num_data \
+            else s.reshape(-1)
+
+    @property
+    def num_queries(self) -> int:
+        if self.query_boundaries is None:
+            return 0
+        return len(self.query_boundaries) - 1
+
+
+def _to_numpy_2d(data) -> tuple:
+    """Accept numpy / pandas / list-of-lists; return (float64 2-D array, names, cat_cols)."""
+    feature_names = None
+    pandas_categorical: List[int] = []
+    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas DataFrame
+        feature_names = [str(c) for c in data.columns]
+        cols = []
+        for i, c in enumerate(data.columns):
+            col = data[c]
+            if str(col.dtype) == "category":
+                cols.append(col.cat.codes.to_numpy().astype(np.float64))
+                pandas_categorical.append(i)
+            else:
+                cols.append(col.to_numpy().astype(np.float64))
+        arr = np.column_stack(cols) if cols else np.empty((len(data), 0))
+    else:
+        arr = np.asarray(data, dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+    return np.ascontiguousarray(arr), feature_names, pandas_categorical
+
+
+class Dataset:
+    """Binned dataset (dataset.h:355 analog).
+
+    Lazily constructed like the python-package Dataset (basic.py:1135): raw
+    data + params are held until ``construct()`` fits bin mappers and
+    produces the packed binned matrix.
+    """
+
+    def __init__(self, data, label=None, weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 reference: Optional["Dataset"] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False):
+        self._raw_input = data
+        self._label_in, self._weight_in = label, weight
+        self._group_in, self._init_score_in = group, init_score
+        self._feature_name_in = feature_name
+        self._categorical_in = categorical_feature
+        self.reference = reference
+        self.params: Dict[str, Any] = dict(params or {})
+        self.free_raw_data = free_raw_data
+
+        self._constructed = False
+        # filled by construct():
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.bin_mappers: List[BinMapper] = []
+        self.used_features: List[int] = []      # indices of non-trivial features
+        self.binned: Optional[np.ndarray] = None  # [N, num_used] uint8/uint16
+        self.bin_offsets: Optional[np.ndarray] = None  # [num_used+1] cumulative bins
+        self.metadata: Optional[Metadata] = None
+        self.feature_names: List[str] = []
+        self.raw_data: Optional[np.ndarray] = None
+        self.max_bin: int = 255
+
+    # ------------------------------------------------------------------
+    def construct(self, config: Optional[Config] = None) -> "Dataset":
+        if self._constructed:
+            return self
+        cfg = config or Config(self.params)
+        arr, names, pandas_cat = _to_numpy_2d(self._raw_input)
+        self.num_data, self.num_total_features = arr.shape
+        self.metadata = Metadata(self.num_data)
+        if self._label_in is not None:
+            self.metadata.set_label(self._label_in)
+        self.metadata.set_weight(self._weight_in)
+        self.metadata.set_group(self._group_in)
+        self.metadata.set_init_score(self._init_score_in)
+
+        if self._feature_name_in != "auto" and self._feature_name_in is not None:
+            self.feature_names = list(self._feature_name_in)
+        elif names is not None:
+            self.feature_names = names
+        else:
+            self.feature_names = [f"Column_{i}" for i in range(self.num_total_features)]
+
+        cat_idx = set(pandas_cat)
+        if self._categorical_in != "auto" and self._categorical_in is not None:
+            for c in self._categorical_in:
+                if isinstance(c, str):
+                    if c in self.feature_names:
+                        cat_idx.add(self.feature_names.index(c))
+                else:
+                    cat_idx.add(int(c))
+        elif isinstance(cfg.categorical_feature, str) and cfg.categorical_feature:
+            for tok in cfg.categorical_feature.split(","):
+                tok = tok.strip()
+                if tok:
+                    cat_idx.add(int(tok))
+
+        if self.reference is not None:
+            # validation set: reuse the training set's bin mappers
+            # (Dataset::CreateValid, dataset.cpp)
+            ref = self.reference.construct(config)
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.bin_offsets = ref.bin_offsets
+            self.max_bin = ref.max_bin
+        else:
+            self._fit_bin_mappers(arr, cfg, cat_idx)
+
+        self._bin_data(arr)
+        keep_raw = (not self.free_raw_data) or bool(cfg.linear_tree)
+        self.raw_data = arr if keep_raw else None
+        self._constructed = True
+        self._raw_input = None
+        return self
+
+    def _fit_bin_mappers(self, arr: np.ndarray, cfg: Config, cat_idx: set) -> None:
+        n = self.num_data
+        sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
+        # deterministic sampled rows (SampleTextDataFromFile analog,
+        # dataset_loader.cpp:961) via data_random_seed
+        if sample_cnt < n:
+            rng = np.random.RandomState(cfg.data_random_seed)
+            sample_rows = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+            sample = arr[sample_rows]
+        else:
+            sample = arr
+        max_bin_by_feature = cfg.max_bin_by_feature
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            m = BinMapper()
+            mb = int(max_bin_by_feature[f]) if max_bin_by_feature else cfg.max_bin
+            bt = BinType.CATEGORICAL if f in cat_idx else BinType.NUMERICAL
+            m.find_bin(sample[:, f], sample_cnt, mb, cfg.min_data_in_bin,
+                       min_split_data=cfg.min_data_in_leaf,
+                       pre_filter=cfg.feature_pre_filter, bin_type=bt,
+                       use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing)
+            self.bin_mappers.append(m)
+        self.used_features = [f for f in range(self.num_total_features)
+                              if not self.bin_mappers[f].is_trivial]
+        nbins = [self.bin_mappers[f].num_bin for f in self.used_features]
+        self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
+        self.max_bin = max([2] + nbins)
+
+    def _bin_data(self, arr: np.ndarray) -> None:
+        nf = len(self.used_features)
+        dtype = np.uint8 if self.max_bin <= 256 else np.uint16
+        out = np.zeros((self.num_data, max(nf, 1)), dtype=dtype)
+        for j, f in enumerate(self.used_features):
+            out[:, j] = self.bin_mappers[f].value_to_bin(arr[:, f]).astype(dtype)
+        self.binned = out
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        """Number of used (non-trivial) features."""
+        return len(self.used_features)
+
+    @property
+    def num_total_bins(self) -> int:
+        return int(self.bin_offsets[-1]) if self.bin_offsets is not None else 0
+
+    def get_label(self) -> np.ndarray:
+        self.construct()
+        return self.metadata.label
+
+    def get_weight(self):
+        self.construct()
+        return self.metadata.weight
+
+    def get_group(self):
+        self.construct()
+        if self.metadata.query_boundaries is None:
+            return None
+        return np.diff(self.metadata.query_boundaries)
+
+    def set_label(self, label):
+        if self.metadata is None:
+            self._label_in = label
+        else:
+            self.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight):
+        if self.metadata is None:
+            self._weight_in = weight
+        else:
+            self.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group):
+        if self.metadata is None:
+            self._group_in = group
+        else:
+            self.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score):
+        if self.metadata is None:
+            self._init_score_in = init_score
+        else:
+            self.metadata.set_init_score(init_score)
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, weight=weight, group=group,
+                       init_score=init_score, reference=self,
+                       params=params or self.params)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row-subset copy (Dataset::CopySubrow, dataset.h:486 analog)."""
+        self.construct()
+        idx = np.asarray(used_indices, dtype=np.int64)
+        sub = Dataset.__new__(Dataset)
+        sub.__dict__.update({k: v for k, v in self.__dict__.items()})
+        sub.num_data = len(idx)
+        sub.binned = self.binned[idx]
+        sub.raw_data = self.raw_data[idx] if self.raw_data is not None else None
+        sub.metadata = Metadata(len(idx))
+        if self.metadata.label is not None:
+            sub.metadata.label = self.metadata.label[idx]
+        if self.metadata.weight is not None:
+            sub.metadata.weight = self.metadata.weight[idx]
+        if self.metadata.init_score is not None:
+            sub.metadata.init_score = self.metadata.init_score[idx]
+        sub.reference = self
+        return sub
+
+    # -- binary cache ----------------------------------------------------
+    def save_binary(self, path: str) -> None:
+        """Binary dataset cache (dataset.cpp SaveBinaryFile analog)."""
+        self.construct()
+        payload: Dict[str, Any] = {
+            "binned": self.binned,
+            "bin_offsets": self.bin_offsets,
+            "used_features": np.asarray(self.used_features, dtype=np.int32),
+            "num_total_features": self.num_total_features,
+            "max_bin": self.max_bin,
+            "feature_names": np.asarray(self.feature_names, dtype=object),
+            "num_mappers": len(self.bin_mappers),
+        }
+        for i, m in enumerate(self.bin_mappers):
+            for k, v in m.to_state().items():
+                payload[f"mapper{i}_{k}"] = v
+        if self.metadata.label is not None:
+            payload["label"] = self.metadata.label
+        if self.metadata.weight is not None:
+            payload["weight"] = self.metadata.weight
+        if self.metadata.query_boundaries is not None:
+            payload["query_boundaries"] = self.metadata.query_boundaries
+        if self.metadata.init_score is not None:
+            payload["init_score"] = self.metadata.init_score
+        if self.raw_data is not None:
+            payload["raw_data"] = self.raw_data
+        np.savez_compressed(path, **payload)
+
+    @classmethod
+    def load_binary(cls, path: str) -> "Dataset":
+        if not os.path.exists(path) and os.path.exists(path + ".npz"):
+            path = path + ".npz"
+        z = np.load(path, allow_pickle=True)
+        ds = cls.__new__(cls)
+        ds.params = {}
+        ds.reference = None
+        ds.free_raw_data = False
+        ds._constructed = True
+        ds._raw_input = None
+        ds.binned = z["binned"]
+        ds.bin_offsets = z["bin_offsets"]
+        ds.used_features = [int(x) for x in z["used_features"]]
+        ds.num_total_features = int(z["num_total_features"])
+        ds.max_bin = int(z["max_bin"])
+        ds.feature_names = [str(x) for x in z["feature_names"]]
+        ds.num_data = ds.binned.shape[0]
+        n_mappers = int(z["num_mappers"])
+        ds.bin_mappers = []
+        for i in range(n_mappers):
+            st = {k.split("_", 1)[1]: z[k] for k in z.files if k.startswith(f"mapper{i}_")}
+            ds.bin_mappers.append(BinMapper.from_state(st))
+        ds.metadata = Metadata(ds.num_data)
+        if "label" in z.files:
+            ds.metadata.label = z["label"]
+        if "weight" in z.files:
+            ds.metadata.weight = z["weight"]
+        if "query_boundaries" in z.files:
+            ds.metadata.query_boundaries = z["query_boundaries"]
+        if "init_score" in z.files:
+            ds.metadata.init_score = z["init_score"]
+        ds.raw_data = z["raw_data"] if "raw_data" in z.files else None
+        return ds
+
+    def num_bins_of(self, used_feature_slot: int) -> int:
+        f = self.used_features[used_feature_slot]
+        return self.bin_mappers[f].num_bin

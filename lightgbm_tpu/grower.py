@@ -1,0 +1,253 @@
+"""Leaf-wise tree grower: a fully device-resident JAX program.
+
+TPU-native re-design of the reference's device learner
+(/root/reference/src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:108-232
+and serial_tree_learner.cpp:159-210): the whole tree build is ONE jitted
+``lax.fori_loop`` with ``num_leaves-1`` trip count (static shapes — SURVEY.md
+§7 "hard parts").  Design translations:
+
+- ``DataPartition``'s permuted index array (data_partition.hpp:161) becomes a
+  row->leaf index vector (``leaf_of_row``), exactly like the CUDA learner's
+  ``data_index_to_leaf_index`` (cuda_data_partition.cu:111) — no reordering,
+  per-leaf work masks by leaf id.
+- Histogram **subtraction trick** (serial_tree_learner.cpp:423-425): only the
+  smaller child's histogram is constructed (masked MXU pass); the sibling is
+  parent - smaller.
+- Split search: vectorized scans over ``[2, F, B]`` (ops/split.py).
+- Distributed: a ``hist_reduce`` hook (identity | ``lax.psum`` over the mesh
+  row axis) makes the same program the data-parallel learner
+  (data_parallel_tree_learner.cpp:174-186's ReduceScatter collapses onto an
+  XLA collective; split decisions are then replicated).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops.histogram import compute_histogram, hist_block_rows
+from .ops.split import SplitParams, SplitResult, find_best_split, leaf_output
+
+
+class TreeArrays(NamedTuple):
+    """Array-encoded tree (include/LightGBM/tree.h:25 analog).
+
+    Internal nodes are 0..num_leaves-2; a child pointer < 0 encodes leaf
+    ``~child`` (tree.h leaf encoding).
+    """
+    num_leaves: jax.Array        # scalar int32, actual number of leaves
+    split_feature: jax.Array     # [L-1] int32 (used-feature slot)
+    threshold_bin: jax.Array     # [L-1] int32
+    default_left: jax.Array      # [L-1] bool
+    left_child: jax.Array        # [L-1] int32
+    right_child: jax.Array       # [L-1] int32
+    split_gain: jax.Array        # [L-1] f32
+    leaf_value: jax.Array        # [L] f32
+    leaf_weight: jax.Array       # [L] f32 (sum hessian)
+    leaf_count: jax.Array        # [L] f32
+    internal_value: jax.Array    # [L-1] f32
+    internal_weight: jax.Array   # [L-1] f32
+    internal_count: jax.Array    # [L-1] f32
+    leaf_depth: jax.Array        # [L] int32
+    leaf_of_row: jax.Array       # [N] int32 — final row -> leaf assignment
+
+
+class _GrowState(NamedTuple):
+    leaf_of_row: jax.Array
+    hist: jax.Array              # [L, F, B, 3]
+    # per-leaf best-split candidates
+    bg: jax.Array                # [L] gain
+    bf: jax.Array                # [L] feature
+    bt: jax.Array                # [L] threshold
+    bdl: jax.Array               # [L] default_left
+    bls: jax.Array               # [L, 3] left sums
+    brs: jax.Array               # [L, 3] right sums
+    blo: jax.Array               # [L] left output
+    bro: jax.Array               # [L] right output
+    # tree arrays under construction
+    split_feature: jax.Array
+    threshold_bin: jax.Array
+    default_left: jax.Array
+    left_child: jax.Array
+    right_child: jax.Array
+    split_gain: jax.Array
+    leaf_value: jax.Array
+    leaf_weight: jax.Array
+    leaf_count: jax.Array
+    internal_value: jax.Array
+    internal_weight: jax.Array
+    internal_count: jax.Array
+    leaf_depth: jax.Array
+    leaf_parent: jax.Array       # [L] int32
+    num_leaves: jax.Array        # scalar int32
+    done: jax.Array              # scalar bool
+
+
+def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
+                max_depth: int = -1, block_rows: int = 0,
+                hist_reduce: Optional[Callable] = None,
+                donate_leaf_of_row: bool = False):
+    """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin)``.
+
+    vals: [N, 3] f32 = (grad, hess, in-bag weight); out-of-bag rows zeroed.
+    """
+    L = int(num_leaves)
+    B = int(num_bins)
+    reduce_fn = hist_reduce or (lambda h: h)
+
+    def _hist(binned, vals):
+        h = compute_histogram(binned, vals, num_bins=B, block_rows=block_rows)
+        return reduce_fn(h)
+
+    def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2):
+        return jax.vmap(
+            lambda h, t, po: find_best_split(h, t, num_bin, na_bin, fmask,
+                                             params, po)
+        )(hist2, totals2, parent_out2)
+
+    def grow_tree(binned, vals, feature_mask, num_bin, na_bin) -> TreeArrays:
+        n, f = binned.shape
+
+        hist0 = _hist(binned, vals)                       # [F, B, 3]
+        total0 = hist0[0].sum(axis=0)                     # [3] root aggregates
+        root_out = leaf_output(total0[0], total0[1], params)
+        res0 = find_best_split(hist0, total0, num_bin, na_bin, feature_mask,
+                               params, root_out)
+
+        neg_inf = jnp.float32(-jnp.inf)
+        st = _GrowState(
+            leaf_of_row=jnp.zeros(n, jnp.int32),
+            hist=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(hist0),
+            bg=jnp.full(L, neg_inf).at[0].set(res0.gain),
+            bf=jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
+            bt=jnp.zeros(L, jnp.int32).at[0].set(res0.threshold),
+            bdl=jnp.zeros(L, bool).at[0].set(res0.default_left),
+            bls=jnp.zeros((L, 3)).at[0].set(res0.left_sum),
+            brs=jnp.zeros((L, 3)).at[0].set(res0.right_sum),
+            blo=jnp.zeros(L).at[0].set(res0.left_output),
+            bro=jnp.zeros(L).at[0].set(res0.right_output),
+            split_feature=jnp.zeros(L - 1, jnp.int32),
+            threshold_bin=jnp.zeros(L - 1, jnp.int32),
+            default_left=jnp.zeros(L - 1, bool),
+            left_child=jnp.zeros(L - 1, jnp.int32),
+            right_child=jnp.zeros(L - 1, jnp.int32),
+            split_gain=jnp.zeros(L - 1, jnp.float32),
+            leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
+            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(total0[1]),
+            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(total0[2]),
+            internal_value=jnp.zeros(L - 1, jnp.float32),
+            internal_weight=jnp.zeros(L - 1, jnp.float32),
+            internal_count=jnp.zeros(L - 1, jnp.float32),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            num_leaves=jnp.int32(1),
+            done=jnp.bool_(False),
+        )
+
+        def split_step(i, st: _GrowState) -> _GrowState:
+            leaf = jnp.argmax(st.bg).astype(jnp.int32)
+            can_split = (st.bg[leaf] > 0.0) & (~st.done)
+
+            def do_split(st: _GrowState) -> _GrowState:
+                new_leaf = (i + 1).astype(jnp.int32)
+                feat, thr = st.bf[leaf], st.bt[leaf]
+                dleft = st.bdl[leaf]
+                lsum, rsum = st.bls[leaf], st.brs[leaf]
+
+                # --- tree bookkeeping (Tree::Split, src/io/tree.cpp) ------
+                parent = st.leaf_parent[leaf]
+                node_ids = jnp.arange(L - 1, dtype=jnp.int32)
+                fix_l = (node_ids == parent) & (st.left_child == ~leaf)
+                fix_r = (node_ids == parent) & (st.right_child == ~leaf)
+                lc = jnp.where(fix_l, i, st.left_child).at[i].set(~leaf)
+                rc = jnp.where(fix_r, i, st.right_child).at[i].set(~new_leaf)
+
+                # --- partition rows (CUDADataPartition::Split analog) -----
+                fcol = jnp.take(binned, feat, axis=1).astype(jnp.int32)
+                nb = na_bin[feat]
+                is_na = (nb >= 0) & (fcol == nb)
+                go_left = jnp.where(is_na, dleft, fcol <= thr)
+                in_leaf = st.leaf_of_row == leaf
+                leaf_of_row = jnp.where(in_leaf & (~go_left), new_leaf,
+                                        st.leaf_of_row)
+
+                # --- histograms: smaller child + subtraction --------------
+                smaller_left = lsum[2] <= rsum[2]
+                smaller_id = jnp.where(smaller_left, leaf, new_leaf)
+                mask = (leaf_of_row == smaller_id).astype(vals.dtype)[:, None]
+                hist_small = _hist(binned, vals * mask)
+                hist_large = st.hist[leaf] - hist_small
+                hl_leaf = jnp.where(smaller_left, hist_small, hist_large)
+                hl_new = jnp.where(smaller_left, hist_large, hist_small)
+                hist = st.hist.at[leaf].set(hl_leaf).at[new_leaf].set(hl_new)
+
+                # --- leaf stats -------------------------------------------
+                d = st.leaf_depth[leaf] + 1
+                lv = st.leaf_value.at[leaf].set(st.blo[leaf]) \
+                                  .at[new_leaf].set(st.bro[leaf])
+                lw = st.leaf_weight.at[leaf].set(lsum[1]).at[new_leaf].set(rsum[1])
+                lcnt = st.leaf_count.at[leaf].set(lsum[2]).at[new_leaf].set(rsum[2])
+                ld = st.leaf_depth.at[leaf].set(d).at[new_leaf].set(d)
+
+                # --- new best splits for both children (batched) ----------
+                hist2 = jnp.stack([hl_leaf, hl_new])
+                tot2 = jnp.stack([lsum, rsum])
+                po2 = jnp.stack([st.blo[leaf], st.bro[leaf]])
+                r2 = _best2(hist2, tot2, num_bin, na_bin, feature_mask, po2)
+                depth_ok = (max_depth <= 0) | (d < max_depth)
+                g2 = jnp.where(depth_ok, r2.gain, -jnp.inf)
+
+                return st._replace(
+                    leaf_of_row=leaf_of_row,
+                    hist=hist,
+                    bg=st.bg.at[leaf].set(g2[0]).at[new_leaf].set(g2[1]),
+                    bf=st.bf.at[leaf].set(r2.feature[0]).at[new_leaf].set(r2.feature[1]),
+                    bt=st.bt.at[leaf].set(r2.threshold[0]).at[new_leaf].set(r2.threshold[1]),
+                    bdl=st.bdl.at[leaf].set(r2.default_left[0]).at[new_leaf].set(r2.default_left[1]),
+                    bls=st.bls.at[leaf].set(r2.left_sum[0]).at[new_leaf].set(r2.left_sum[1]),
+                    brs=st.brs.at[leaf].set(r2.right_sum[0]).at[new_leaf].set(r2.right_sum[1]),
+                    blo=st.blo.at[leaf].set(r2.left_output[0]).at[new_leaf].set(r2.left_output[1]),
+                    bro=st.bro.at[leaf].set(r2.right_output[0]).at[new_leaf].set(r2.right_output[1]),
+                    split_feature=st.split_feature.at[i].set(feat),
+                    threshold_bin=st.threshold_bin.at[i].set(thr),
+                    default_left=st.default_left.at[i].set(dleft),
+                    left_child=lc,
+                    right_child=rc,
+                    split_gain=st.split_gain.at[i].set(st.bg[leaf]),
+                    leaf_value=lv, leaf_weight=lw, leaf_count=lcnt,
+                    internal_value=st.internal_value.at[i].set(st.leaf_value[leaf]),
+                    internal_weight=st.internal_weight.at[i].set(st.leaf_weight[leaf]),
+                    internal_count=st.internal_count.at[i].set(st.leaf_count[leaf]),
+                    leaf_depth=ld,
+                    leaf_parent=st.leaf_parent.at[leaf].set(i).at[new_leaf].set(i),
+                    num_leaves=new_leaf + 1,
+                    done=st.done,
+                )
+
+            return lax.cond(can_split, do_split,
+                            lambda s: s._replace(done=jnp.bool_(True)), st)
+
+        st = lax.fori_loop(0, L - 1, split_step, st)
+        return TreeArrays(
+            num_leaves=st.num_leaves,
+            split_feature=st.split_feature,
+            threshold_bin=st.threshold_bin,
+            default_left=st.default_left,
+            left_child=st.left_child,
+            right_child=st.right_child,
+            split_gain=st.split_gain,
+            leaf_value=st.leaf_value,
+            leaf_weight=st.leaf_weight,
+            leaf_count=st.leaf_count,
+            internal_value=st.internal_value,
+            internal_weight=st.internal_weight,
+            internal_count=st.internal_count,
+            leaf_depth=st.leaf_depth,
+            leaf_of_row=st.leaf_of_row,
+        )
+
+    return jax.jit(grow_tree, donate_argnums=())

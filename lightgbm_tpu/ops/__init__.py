@@ -1,0 +1,2 @@
+from .histogram import compute_histogram, hist_block_rows
+from .split import find_best_split, SplitParams

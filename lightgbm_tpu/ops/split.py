@@ -1,0 +1,165 @@
+"""Vectorized best-split search over histograms.
+
+Replaces the reference's per-feature sequential threshold scan
+``FeatureHistogram::FindBestThresholdSequentially``
+(/root/reference/src/treelearner/feature_histogram.hpp:856-1050) and the CUDA
+``FindBestSplitsForLeafKernel``
+(/root/reference/src/treelearner/cuda/cuda_best_split_finder.cu:603): the
+two directional scans (missing->right / missing->left) become cumulative
+sums + masked argmax over a ``[2, F, B]`` gain tensor — branchless, all
+features at once on the VPU.
+
+Gain / leaf-output math follows feature_histogram.hpp:737-854
+(``ThresholdL1``, ``CalculateSplittedLeafOutput``, ``GetSplitGains``) with
+lambda_l1 / lambda_l2 / max_delta_step / path_smooth.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+kEpsilon = 1e-15
+kMinScore = -jnp.inf
+
+
+class SplitParams(NamedTuple):
+    """Static split hyperparameters (hashable; closed over at jit time)."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    path_smooth: float = 0.0
+
+
+class SplitResult(NamedTuple):
+    """Per-leaf best split (SplitInfo analog, split_info.hpp:55)."""
+    gain: jax.Array          # f32; <=0 / -inf when invalid
+    feature: jax.Array       # int32 (used-feature slot)
+    threshold: jax.Array     # int32 bin threshold (go left if bin <= threshold)
+    default_left: jax.Array  # bool
+    left_sum: jax.Array      # [3] (g, h, count)
+    right_sum: jax.Array     # [3]
+    left_output: jax.Array   # f32 leaf output
+    right_output: jax.Array  # f32
+
+
+def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
+    """ThresholdL1 (feature_histogram.hpp:751)."""
+    if l1 <= 0.0:
+        return s
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(sum_g, sum_h, p: SplitParams, parent_output=None):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:761-788)."""
+    num = -threshold_l1(sum_g, p.lambda_l1)
+    denom = sum_h + p.lambda_l2
+    if p.path_smooth > 0.0 and parent_output is not None:
+        # path smoothing: output = n/(n+λ_smooth) * raw + λ/(n+λ_smooth)*parent
+        raw = num / jnp.maximum(denom, kEpsilon)
+        # note: reference smooths with data count; approximated by hessian weight
+        n_data = sum_h
+        smooth_w = n_data / (n_data + p.path_smooth)
+        out = raw * smooth_w + parent_output * (1.0 - smooth_w)
+    else:
+        out = num / jnp.maximum(denom, kEpsilon)
+    if p.max_delta_step > 0.0:
+        out = jnp.clip(out, -p.max_delta_step, p.max_delta_step)
+    return out
+
+
+def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=None):
+    """GetLeafGain (feature_histogram.hpp:790-820): gain of a leaf with the
+    (possibly clipped/smoothed) optimal output."""
+    if p.max_delta_step <= 0.0 and p.path_smooth <= 0.0:
+        t = threshold_l1(sum_g, p.lambda_l1)
+        return t * t / jnp.maximum(sum_h + p.lambda_l2, kEpsilon)
+    out = leaf_output(sum_g, sum_h, p, parent_output)
+    tg = threshold_l1(sum_g, p.lambda_l1)
+    # GetLeafGainGivenOutput: -(2*G̃*w + (H+λ2)*w²)
+    return -(2.0 * tg * out + (sum_h + p.lambda_l2) * out * out)
+
+
+def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
+                    na_bin: jax.Array, feature_mask: jax.Array,
+                    params: SplitParams, parent_output: jax.Array = None
+                    ) -> SplitResult:
+    """Best (feature, threshold-bin, missing-direction) for one leaf.
+
+    hist:         [F, B, 3] f32 — per-feature histograms (g, h, count)
+    total:        [3] parent aggregates
+    num_bin:      [F] int32 valid bin count per feature
+    na_bin:       [F] int32 NaN-bin index or -1
+    feature_mask: [F] bool — feature_fraction / interaction constraint mask
+    """
+    f, b, _ = hist.shape
+    cum = jnp.cumsum(hist, axis=1)                      # [F, B, 3] inclusive
+    bins = jnp.arange(b, dtype=jnp.int32)
+
+    has_na = (na_bin >= 0)
+    na_vals = jnp.where(has_na[:, None],
+                        jnp.take_along_axis(
+                            hist, jnp.maximum(na_bin, 0)[:, None, None]
+                            .repeat(3, axis=2), axis=1)[:, 0, :],
+                        0.0)                            # [F, 3]
+
+    # dir 0: missing -> right. left(b) = cum[b]  (na bin == last, never left)
+    # dir 1: missing -> left.  left(b) = cum[b] + hist[na]
+    left0 = cum
+    left1 = cum + na_vals[:, None, :]
+    lefts = jnp.stack([left0, left1], axis=0)           # [2, F, B, 3]
+    rights = total[None, None, None, :] - lefts
+
+    gl, hl, cl = lefts[..., 0], lefts[..., 1], lefts[..., 2]
+    gr, hr, cr = rights[..., 0], rights[..., 1], rights[..., 2]
+
+    parent_out = leaf_output(total[0], total[1], params) if parent_output is None \
+        else parent_output
+    gain_l = leaf_gain(gl, hl, params, parent_out)
+    gain_r = leaf_gain(gr, hr, params, parent_out)
+    gain_shift = leaf_gain(total[0], total[1], params)
+    split_gain = gain_l + gain_r - (gain_shift + params.min_gain_to_split)
+
+    # validity masks (FindBestThresholdSequentially early-continue conditions)
+    md = float(params.min_data_in_leaf) - 0.5
+    mh = params.min_sum_hessian_in_leaf
+    # threshold range: b <= num_bin - 2 excluding the NaN bin from the scan
+    max_t = jnp.where(has_na, num_bin - 2, num_bin - 2)  # na bin = num_bin-1
+    valid = (bins[None, None, :] <= max_t[None, :, None])
+    valid &= feature_mask[None, :, None]
+    valid &= (cl >= md) & (cr >= md)
+    valid &= (hl >= mh) & (hr >= mh)
+    valid &= split_gain > kEpsilon
+    # dir-1 scan only exists for features with a NaN bin
+    valid &= jnp.stack([jnp.ones((f, b), bool),
+                        jnp.broadcast_to(has_na[:, None], (f, b))], axis=0)
+
+    gains = jnp.where(valid, split_gain, kMinScore)     # [2, F, B]
+    flat = gains.reshape(-1)
+    best = jnp.argmax(flat)                             # first max: dir0, low f, low b
+    best_gain = flat[best]
+    best_dir = best // (f * b)
+    rem = best % (f * b)
+    best_f = (rem // b).astype(jnp.int32)
+    best_b = (rem % b).astype(jnp.int32)
+
+    sel = lefts[best_dir, best_f, best_b]               # [3]
+    left_sum = sel
+    right_sum = total - sel
+    lo = leaf_output(left_sum[0], left_sum[1], params, parent_out)
+    ro = leaf_output(right_sum[0], right_sum[1], params, parent_out)
+    return SplitResult(
+        gain=best_gain,
+        feature=best_f,
+        threshold=best_b,
+        default_left=(best_dir == 1),
+        left_sum=left_sum,
+        right_sum=right_sum,
+        left_output=lo.astype(jnp.float32),
+        right_output=ro.astype(jnp.float32),
+    )

@@ -1,0 +1,50 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh, the same way the reference
+simulates multi-machine training with localhost sockets
+(/root/reference/tests/distributed/_test_distributed.py) — see SURVEY.md §4.
+
+NOTE on platform forcing: the environment's sitecustomize imports jax and
+registers the TPU (axon) PJRT plugin at interpreter start, freezing
+``jax_platforms``; setting the JAX_PLATFORMS env var here is too late.
+``jax.config.update`` below is the supported override and prevents the TPU
+backend from initializing during tests (the TPU tunnel is exclusive and
+slow to claim).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.RandomState(42)
+
+
+@pytest.fixture(scope="session")
+def binary_data():
+    """Synthetic binary classification set (sklearn-style, utils.py analog)."""
+    rs = np.random.RandomState(0)
+    n, f = 4000, 20
+    x = rs.randn(n, f)
+    logit = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3] + 0.3 * rs.randn(n)
+    y = (logit > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture(scope="session")
+def regression_data():
+    rs = np.random.RandomState(1)
+    n, f = 4000, 15
+    x = rs.randn(n, f)
+    y = (2.0 * x[:, 0] + x[:, 1] ** 2 - 1.5 * x[:, 2] + 0.1 * rs.randn(n)).astype(np.float32)
+    return x, y

@@ -1,0 +1,145 @@
+"""Tests for BinMapper / Dataset (test_basic.py analog, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import BinMapper, BinType, MissingType
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Dataset
+
+
+class TestBinMapper:
+    def test_uniform_values(self):
+        m = BinMapper()
+        vals = np.linspace(-1, 1, 1000)
+        m.find_bin(vals, 1000, max_bin=16, min_data_in_bin=3)
+        assert 2 <= m.num_bin <= 16
+        bins = m.value_to_bin(vals)
+        assert bins.min() == 0
+        assert bins.max() == m.num_bin - 1
+        # monotone: larger value -> same or larger bin
+        assert (np.diff(bins) >= 0).all()
+        # roughly equal counts
+        counts = np.bincount(bins)
+        assert counts.max() <= 3 * counts.min() + 10
+
+    def test_few_distinct_values(self):
+        m = BinMapper()
+        vals = np.repeat([1.0, 2.0, 5.0], 100)
+        m.find_bin(vals, 300, max_bin=255, min_data_in_bin=3)
+        bins = m.value_to_bin(np.array([1.0, 2.0, 5.0]))
+        assert len(set(bins.tolist())) == 3
+
+    def test_min_data_in_bin_merges(self):
+        m = BinMapper()
+        vals = np.concatenate([np.zeros(100), np.ones(2), np.full(100, 2.0)])
+        m.find_bin(vals, 202, max_bin=255, min_data_in_bin=5)
+        b0, b1, b2 = m.value_to_bin(np.array([0.0, 1.0, 2.0]))
+        assert b1 in (b0, b2)  # tiny middle group merged into a neighbor
+
+    def test_nan_missing(self):
+        m = BinMapper()
+        vals = np.array([1.0, 2.0, 3.0, np.nan, np.nan, 4.0] * 50)
+        m.find_bin(vals, 300, max_bin=16, min_data_in_bin=1)
+        assert m.missing_type == MissingType.NAN
+        bins = m.value_to_bin(np.array([1.0, np.nan]))
+        assert bins[1] == m.num_bin - 1
+        assert bins[0] < m.num_bin - 1
+
+    def test_zero_as_missing(self):
+        m = BinMapper()
+        vals = np.array([0.0, 1.0, 2.0, 3.0] * 50)
+        m.find_bin(vals, 200, max_bin=16, min_data_in_bin=1, zero_as_missing=True)
+        assert m.missing_type == MissingType.ZERO
+        bz, bn = m.value_to_bin(np.array([0.0, np.nan]))
+        assert bz == bn  # NaN goes to the zero bin
+
+    def test_categorical(self):
+        m = BinMapper()
+        vals = np.concatenate([np.full(100, 7.0), np.full(50, 3.0), np.full(10, 9.0)])
+        m.find_bin(vals, 160, max_bin=32, min_data_in_bin=1,
+                   bin_type=BinType.CATEGORICAL)
+        assert m.bin_type == BinType.CATEGORICAL
+        bins = m.value_to_bin(np.array([7.0, 3.0, 9.0]))
+        assert bins[0] == 0  # most frequent category -> bin 0
+        assert len(set(bins.tolist())) == 3
+        # unseen category falls back to bin 0 semantics handled at split level
+        assert m.value_to_bin(np.array([123.0]))[0] == 0
+
+    def test_roundtrip_state(self):
+        m = BinMapper()
+        vals = np.random.RandomState(0).randn(500)
+        m.find_bin(vals, 500, max_bin=32, min_data_in_bin=3)
+        m2 = BinMapper.from_state(m.to_state())
+        x = np.random.RandomState(1).randn(100)
+        np.testing.assert_array_equal(m.value_to_bin(x), m2.value_to_bin(x))
+
+
+class TestDataset:
+    def test_basic_construct(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(500, 5)
+        x[:, 3] = 1.0  # constant -> trivial, dropped
+        y = rs.rand(500)
+        ds = Dataset(x, label=y, params={"max_bin": 15}).construct()
+        assert ds.num_data == 500
+        assert ds.num_total_features == 5
+        assert 3 not in ds.used_features
+        assert ds.binned.shape == (500, len(ds.used_features))
+        assert ds.binned.dtype == np.uint8
+        assert ds.max_bin <= 15
+        np.testing.assert_allclose(ds.get_label(), y, rtol=1e-6)
+
+    def test_valid_aligned_to_train(self):
+        rs = np.random.RandomState(1)
+        xt = rs.randn(400, 4)
+        xv = rs.randn(100, 4)
+        train = Dataset(xt, label=rs.rand(400)).construct()
+        valid = train.create_valid(xv, label=rs.rand(100)).construct()
+        assert valid.bin_mappers is train.bin_mappers
+        assert valid.binned.shape[1] == train.binned.shape[1]
+
+    def test_group_and_weight(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(100, 3)
+        ds = Dataset(x, label=rs.rand(100), weight=np.ones(100),
+                     group=[30, 30, 40]).construct()
+        assert ds.metadata.num_queries == 3
+        assert ds.metadata.query_boundaries[-1] == 100
+        with pytest.raises(ValueError):
+            Dataset(x, label=rs.rand(100), group=[10, 10]).construct()
+
+    def test_binary_cache_roundtrip(self, tmp_path):
+        rs = np.random.RandomState(3)
+        x = rs.randn(200, 4)
+        ds = Dataset(x, label=rs.rand(200), weight=rs.rand(200)).construct()
+        p = str(tmp_path / "cache.npz")
+        ds.save_binary(p)
+        ds2 = Dataset.load_binary(p)
+        np.testing.assert_array_equal(ds.binned, ds2.binned)
+        np.testing.assert_allclose(ds.get_label(), ds2.get_label())
+        np.testing.assert_array_equal(ds.bin_offsets, ds2.bin_offsets)
+        x2 = rs.randn(50)
+        np.testing.assert_array_equal(ds.bin_mappers[0].value_to_bin(x2),
+                                      ds2.bin_mappers[0].value_to_bin(x2))
+
+    def test_subset(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(300, 4)
+        y = rs.rand(300)
+        ds = Dataset(x, label=y).construct()
+        sub = ds.subset(np.arange(0, 300, 3))
+        assert sub.num_data == 100
+        np.testing.assert_allclose(sub.get_label(), y[::3])
+        np.testing.assert_array_equal(sub.binned, ds.binned[::3])
+
+    def test_pandas_categorical(self):
+        pd = pytest.importorskip("pandas")
+        rs = np.random.RandomState(5)
+        df = pd.DataFrame({
+            "a": rs.randn(300),
+            "b": pd.Categorical(rs.choice(["x", "y", "z"], 300)),
+        })
+        ds = Dataset(df, label=rs.rand(300)).construct()
+        assert ds.feature_names == ["a", "b"]
+        assert ds.bin_mappers[1].bin_type == BinType.CATEGORICAL
