@@ -11,9 +11,15 @@ reference's (`Dataset`, `Booster`, `train`, `cv`, sklearn wrappers).
 __version__ = "0.1.0"
 
 from .binning import BinMapper, BinType, MissingType
+from .booster import Booster
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
 from .config import Config
 from .dataset import Dataset
+from .engine import CVBooster, cv, train
 
 __all__ = [
-    "BinMapper", "BinType", "MissingType", "Config", "Dataset",
+    "BinMapper", "BinType", "MissingType", "Booster", "Config", "CVBooster",
+    "Dataset", "EarlyStopException", "cv", "early_stopping", "log_evaluation",
+    "record_evaluation", "reset_parameter", "train",
 ]

@@ -1,0 +1,363 @@
+"""Booster: the user-facing model handle.
+
+Analog of the reference python-package ``Booster`` (basic.py:2548) fused
+with the C-API Booster wrapper (c_api.cpp:106) — in this TPU-native rebuild
+there is no C shim between them, the Booster drives the device boosting
+model directly.  Model (de)serialization follows the reference text format
+(``GBDT::SaveModelToString`` / ``LoadModelFromString``,
+/root/reference/src/boosting/gbdt_model_text.cpp:311, 421) so models
+round-trip and remain ecosystem-readable.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .config import Config
+from .dataset import Dataset
+from .metrics import Metric, create_metric
+from .models import create_boosting
+from .objectives import create_objective
+from .tree_model import Tree
+
+
+def _objective_to_string(cfg: Config) -> str:
+    o = cfg.objective
+    if o == "binary":
+        return f"binary sigmoid:{cfg.sigmoid:g}"
+    if o in ("multiclass", "multiclassova"):
+        return f"{o} num_class:{cfg.num_class}"
+    if o == "lambdarank":
+        return "lambdarank"
+    if o == "quantile":
+        return f"quantile alpha:{cfg.alpha:g}"
+    if o == "huber":
+        return f"huber alpha:{cfg.alpha:g}"
+    if o == "fair":
+        return f"fair fair_c:{cfg.fair_c:g}"
+    if o == "tweedie":
+        return f"tweedie tweedie_variance_power:{cfg.tweedie_variance_power:g}"
+    return o
+
+
+def _objective_from_string(s: str) -> Dict[str, Any]:
+    toks = s.split()
+    out: Dict[str, Any] = {"objective": toks[0]} if toks else {}
+    for t in toks[1:]:
+        if ":" in t:
+            k, v = t.split(":", 1)
+            out[k] = v
+    return out
+
+
+class Booster:
+    """Training/prediction handle (basic.py:2548 / boosting.h:27 analog)."""
+
+    def __init__(self, params: Optional[Dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None,
+                 hist_reduce=None):
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._valid_names: List[str] = []
+        self._train_metrics: List[Metric] = []
+        self._valid_metrics: List[List[Metric]] = []
+        self.trees: List[Tree] = []
+        self.tree_weights: List[float] = []
+        self.feature_names: List[str] = []
+        self.pandas_categorical = None
+        self._model = None
+        self.train_set = None
+        self._num_class = 1
+        self._num_tree_per_iteration = 1
+        self._average_output = False
+        self._max_feature_idx = 0
+
+        if model_file is not None:
+            with open(model_file) as f:
+                self._load_model_string(f.read())
+            return
+        if model_str is not None:
+            self._load_model_string(model_str)
+            return
+        if train_set is None:
+            raise ValueError("Booster needs train_set, model_file or model_str")
+
+        self.config = Config(params or {})
+        self.train_set = train_set.construct(self.config)
+        self.objective = create_objective(self.config)
+        self._model = create_boosting(self.config, self.train_set,
+                                      self.objective, hist_reduce)
+        self._num_class = self.config.num_class
+        self._num_tree_per_iteration = self.config.num_model_per_iteration
+        self._average_output = getattr(self._model, "average_output", False)
+        self.feature_names = list(self.train_set.feature_names)
+        self._max_feature_idx = self.train_set.num_total_features - 1
+
+        for name in self.config.default_metric():
+            m = create_metric(name, self.config)
+            if m is not None:
+                m.init(self.train_set.metadata, self.train_set.num_data)
+                self._train_metrics.append(m)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if self._model is None:
+            raise ValueError("cannot add validation data to a loaded model")
+        data.reference = self.train_set
+        data.construct(self.config)
+        self._model.add_valid_set(data)
+        self._valid_names.append(name)
+        ms = []
+        for mname in self.config.default_metric():
+            m = create_metric(mname, self.config)
+            if m is not None:
+                m.init(data.metadata, data.num_data)
+                ms.append(m)
+        self._valid_metrics.append(ms)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits
+        (LGBM_BoosterUpdateOneIter analog, c_api.cpp:1686)."""
+        if fobj is not None:
+            preds = self._model.train_score()
+            if self._num_tree_per_iteration == 1:
+                preds = preds[:, 0]
+            grad, hess = fobj(preds, self.train_set)
+            stopped = self._model.train_one_iter(np.asarray(grad),
+                                                 np.asarray(hess))
+        else:
+            stopped = self._model.train_one_iter()
+        self._sync_trees()
+        return stopped
+
+    def rollback_one_iter(self) -> "Booster":
+        self._model.rollback_one_iter()
+        self._sync_trees()
+        return self
+
+    def _sync_trees(self) -> None:
+        self.trees = self._model.models
+        self.tree_weights = self._model.tree_weights
+
+    @property
+    def current_iteration(self) -> int:
+        if self._model is not None:
+            return self._model.num_iterations_trained
+        return len(self.trees) // self._num_tree_per_iteration
+
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def num_model_per_iteration(self) -> int:
+        return self._num_tree_per_iteration
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List[Tuple]:
+        score = self._model.train_score()
+        return self._eval_set("training", score, self._train_metrics,
+                              self.train_set, feval)
+
+    def eval_valid(self, feval=None) -> List[Tuple]:
+        out = []
+        for i, name in enumerate(self._valid_names):
+            score = self._model.valid_score(i)
+            ds = self._model.valid_sets[i][0]
+            out.extend(self._eval_set(name, score, self._valid_metrics[i],
+                                      ds, feval))
+        return out
+
+    def _eval_set(self, name, score, metrics, dataset, feval) -> List[Tuple]:
+        s = score[:, 0] if self._num_tree_per_iteration == 1 else score
+        results = []
+        for m in metrics:
+            for mname, val, hib in m.eval(s):
+                results.append((name, mname, val, hib))
+        if feval is not None:
+            for fe in (feval if isinstance(feval, (list, tuple)) else [feval]):
+                r = fe(s, dataset)
+                rs = r if isinstance(r, list) else [r]
+                for (mname, val, hib) in rs:
+                    results.append((name, mname, val, hib))
+        return results
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kw) -> np.ndarray:
+        """Prediction on raw features (gbdt_prediction.cpp:97 inner loop,
+        Predictor analog)."""
+        from .dataset import _to_numpy_2d
+        x, _, _ = _to_numpy_2d(data)
+        n = len(x)
+        k = self._num_tree_per_iteration
+        if num_iteration is None or num_iteration <= 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else
+                             len(self.trees) // k)
+        t0, t1 = start_iteration * k, min((start_iteration + num_iteration) * k,
+                                          len(self.trees))
+        if pred_leaf:
+            out = np.zeros((n, t1 - t0), np.int32)
+            for i, ti in enumerate(range(t0, t1)):
+                out[:, i] = self.trees[ti].predict_leaf(x)
+            return out
+        if pred_contrib:
+            from .shap import predict_contrib
+            return predict_contrib(self, x, t0, t1)
+
+        score = np.zeros((n, k))
+        for ti in range(t0, t1):
+            score[:, ti % k] += self.tree_weights[ti] * self.trees[ti].predict(x)
+        if self._average_output and t1 > t0:
+            score /= (t1 - t0) // k
+        if not raw_score and self.objective is not None:
+            import jax.numpy as jnp
+            conv = self.objective.convert_output(
+                jnp.asarray(score if k > 1 else score[:, 0]))
+            return np.asarray(conv)
+        return score if k > 1 else score[:, 0]
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """FeatureImportance (gbdt.cpp / boosting.h:270)."""
+        nf = self._max_feature_idx + 1
+        imp = np.zeros(nf)
+        trees = self.trees if iteration is None else \
+            self.trees[:iteration * self._num_tree_per_iteration]
+        for t in trees:
+            for i in range(t.num_nodes()):
+                if importance_type == "split":
+                    imp[t.split_feature[i]] += 1
+                else:
+                    imp[t.split_feature[i]] += t.split_gain[i]
+        return imp
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        """SaveModelToString (gbdt_model_text.cpp:311)."""
+        cfg = getattr(self, "config", None)
+        buf = io.StringIO()
+        buf.write("tree\n")
+        buf.write("version=v3\n")
+        buf.write(f"num_class={self._num_class}\n")
+        buf.write(f"num_tree_per_iteration={self._num_tree_per_iteration}\n")
+        buf.write("label_index=0\n")
+        buf.write(f"max_feature_idx={self._max_feature_idx}\n")
+        obj_str = _objective_to_string(cfg) if cfg else getattr(
+            self, "_objective_str", "regression")
+        buf.write(f"objective={obj_str}\n")
+        if self._average_output:
+            buf.write("average_output\n")
+        names = self.feature_names or [f"Column_{i}"
+                                       for i in range(self._max_feature_idx + 1)]
+        buf.write("feature_names=" + " ".join(names) + "\n")
+        buf.write("feature_infos=" + " ".join(self._feature_infos()) + "\n")
+
+        k = self._num_tree_per_iteration
+        t0 = start_iteration * k
+        t1 = len(self.trees) if num_iteration is None else \
+            min(t0 + num_iteration * k, len(self.trees))
+        blocks = []
+        for i, ti in enumerate(range(t0, t1)):
+            t = self.trees[ti]
+            w = self.tree_weights[ti] if ti < len(self.tree_weights) else 1.0
+            if w != 1.0:
+                import copy
+                t = copy.deepcopy(t)
+                t.leaf_value *= w
+                t.internal_value *= w
+            blocks.append(t.to_string(i) + "\n")
+        sizes = [len(b.encode()) for b in blocks]
+        buf.write("tree_sizes=" + " ".join(str(s) for s in sizes) + "\n\n")
+        for b in blocks:
+            buf.write(b)
+        buf.write("end of trees\n\n")
+        buf.write("feature_importances:\n")
+        imp = self.feature_importance("gain")
+        order = np.argsort(-imp)
+        for fi in order:
+            if imp[fi] > 0:
+                buf.write(f"{names[fi]}={imp[fi]:g}\n")
+        buf.write("\nparameters:\n")
+        if cfg is not None:
+            for key, val in sorted(cfg.raw_params.items()):
+                buf.write(f"[{key}: {val}]\n")
+        buf.write("end of parameters\n\n")
+        buf.write("pandas_categorical:null\n")
+        return buf.getvalue()
+
+    def _feature_infos(self) -> List[str]:
+        infos = []
+        ds = self.train_set
+        if ds is None or ds.bin_mappers is None:
+            return ["none"] * (self._max_feature_idx + 1)
+        for f in range(ds.num_total_features):
+            m = ds.bin_mappers[f]
+            if m.is_trivial:
+                infos.append("none")
+            elif m.bin_type.name == "CATEGORICAL":
+                infos.append(":".join(str(int(c)) for c in m.categories))
+            else:
+                ub = m.bin_upper_bound
+                finite = ub[np.isfinite(ub)]
+                lo = float(finite[0]) if len(finite) else 0.0
+                hi = float(finite[-1]) if len(finite) else 0.0
+                infos.append(f"[{lo:g}:{hi:g}]")
+        return infos
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    # ------------------------------------------------------------------
+    def _load_model_string(self, s: str) -> None:
+        """LoadModelFromString (gbdt_model_text.cpp:421)."""
+        header, _, rest = s.partition("\nTree=")
+        kv: Dict[str, str] = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+            elif line.strip() == "average_output":
+                self._average_output = True
+        self._num_class = int(kv.get("num_class", "1"))
+        self._num_tree_per_iteration = int(kv.get("num_tree_per_iteration", "1"))
+        self._max_feature_idx = int(kv.get("max_feature_idx", "0"))
+        self._objective_str = kv.get("objective", "regression")
+        self.feature_names = kv.get("feature_names", "").split(" ") \
+            if kv.get("feature_names") else []
+        obj_kv = _objective_from_string(self._objective_str)
+        params = {"objective": obj_kv.pop("objective", "regression")}
+        params.update(obj_kv)
+        self.config = Config(params)
+        self.objective = create_objective(self.config)
+
+        body = "Tree=" + rest
+        tree_blocks = body.split("\nend of trees")[0]
+        self.trees = []
+        for block in tree_blocks.split("Tree="):
+            block = block.strip()
+            if not block:
+                continue
+            self.trees.append(Tree.from_string("Tree=" + block))
+        self.tree_weights = [1.0] * len(self.trees)
+        self.best_iteration = -1
+
+    @classmethod
+    def model_from_string(cls, model_str: str) -> "Booster":
+        return cls(model_str=model_str)
+
+    def __deepcopy__(self, memo):
+        return Booster(model_str=self.model_to_string())

@@ -1,0 +1,123 @@
+"""Training callbacks (reference: python-package/lightgbm/callback.py:15-356).
+
+Same surface: ``log_evaluation``, ``record_evaluation``, ``reset_parameter``,
+``early_stopping``; early stopping signals via ``EarlyStopException`` caught
+by the train loop (engine.py:252 pattern).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+def _fmt_eval(res) -> str:
+    name, metric, value, _ = res
+    return f"{name}'s {metric}: {value:g}"
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            msg = "\t".join(_fmt_eval(r) for r in env.evaluation_result_list)
+            print(f"[{env.iteration + 1}]\t{msg}")
+    _callback.order = 10
+    return _callback
+
+
+def record_evaluation(eval_result: Dict) -> Callable:
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result must be a dict")
+
+    def _callback(env: CallbackEnv) -> None:
+        for name, metric, value, _ in env.evaluation_result_list:
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, []).append(value)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs) -> Callable:
+    """Per-iteration parameter schedule; supports ``learning_rate`` as a
+    list or ``f(iteration) -> value`` (callback.py reset_parameter)."""
+
+    def _callback(env: CallbackEnv) -> None:
+        it = env.iteration - env.begin_iteration
+        for key, value in kwargs.items():
+            new_val = value[it] if isinstance(value, list) else value(it)
+            if key == "learning_rate":
+                env.model._model.learning_rate = new_val
+            else:
+                setattr(env.model._model.config, key, new_val)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta: float = 0.0) -> Callable:
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[list] = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = bool(env.evaluation_result_list)
+        if not enabled[0]:
+            return
+        best_score.clear(), best_iter.clear()
+        best_score_list.clear(), cmp_op.clear()
+        first_metric[0] = env.evaluation_result_list[0][1].split("@")[0]
+        for (_name, _metric, _val, higher_better) in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if higher_better:
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda new, best: new > best + min_delta)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda new, best: new < best - min_delta)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, (name, metric, val, _hib) in enumerate(env.evaluation_result_list):
+            if best_score_list[i] is None or cmp_op[i](val, best_score[i]):
+                best_score[i] = val
+                best_iter[i] = env.iteration
+                best_score_list[i] = list(env.evaluation_result_list)
+            if first_metric_only and metric.split("@")[0] != first_metric[0]:
+                continue
+            if name == "training":
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    print(f"Early stopping, best iteration is:\n"
+                          f"[{best_iter[i] + 1}]\t" +
+                          "\t".join(_fmt_eval(r) for r in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    print(f"Did not meet early stopping. Best iteration is:\n"
+                          f"[{best_iter[i] + 1}]\t" +
+                          "\t".join(_fmt_eval(r) for r in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+    _callback.order = 30
+    return _callback

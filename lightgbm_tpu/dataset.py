@@ -306,6 +306,19 @@ class Dataset:
         sub.reference = self
         return sub
 
+    def _group_from_parent(self, parent: "Dataset", idx: np.ndarray) -> None:
+        """Reconstruct query boundaries for a row subset whose indices cover
+        whole queries (cv fold construction)."""
+        qb = parent.metadata.query_boundaries
+        if qb is None:
+            return
+        qid = np.searchsorted(qb, np.asarray(idx), side="right") - 1
+        # run-length encode consecutive query ids
+        change = np.nonzero(np.diff(qid))[0] + 1
+        starts = np.concatenate([[0], change, [len(qid)]])
+        sizes = np.diff(starts)
+        self.metadata.set_group(sizes)
+
     # -- binary cache ----------------------------------------------------
     def save_binary(self, path: str) -> None:
         """Binary dataset cache (dataset.cpp SaveBinaryFile analog)."""

@@ -1,0 +1,219 @@
+"""Training entry points: ``train`` and ``cv``.
+
+Analog of the reference python-package engine
+(/root/reference/python-package/lightgbm/engine.py:25 ``train``, :375 ``cv``):
+parameter normalization, valid-set wiring, per-iteration callbacks, early
+stopping via EarlyStopException (engine.py:252), and CVBooster aggregation.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .booster import Booster
+from .callback import CallbackEnv, EarlyStopException
+from .config import Config
+from .dataset import Dataset
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          fobj: Optional[Callable] = None) -> Booster:
+    """Train a gradient-boosted model (engine.py:25 analog)."""
+    params = dict(params or {})
+    cfg = Config(params)
+    if cfg.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = cfg.num_iterations
+
+    # continued training: init_model predictions become the init score
+    # (application.cpp:88-94 input_model pattern)
+    prev_booster = None
+    if init_model is not None:
+        prev_booster = (Booster(model_file=init_model)
+                        if isinstance(init_model, str) else init_model)
+        raw = prev_booster.predict(_dataset_raw(train_set), raw_score=True)
+        train_set.set_init_score(np.asarray(raw, np.float64))
+
+    booster = Booster(params=params, train_set=train_set)
+    if valid_sets:
+        names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+        for vs, name in zip(valid_sets, names):
+            if vs is train_set:
+                continue
+            booster.add_valid(vs, name)
+
+    cbs = list(callbacks or [])
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        cbs.append(callback_mod.early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only, cfg.verbosity > 0))
+    if cfg.verbosity > 0 and cfg.metric_freq > 0 and \
+            not any(getattr(c, "order", 0) == 10 and not
+                    getattr(c, "before_iteration", False) for c in cbs):
+        pass  # explicit log_evaluation only (sklearn-compatible silence)
+    cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
+    cbs_after = [c for c in cbs if not getattr(c, "before_iteration", False)]
+    cbs_before.sort(key=lambda c: getattr(c, "order", 0))
+    cbs_after.sort(key=lambda c: getattr(c, "order", 0))
+
+    for i in range(num_boost_round):
+        env = CallbackEnv(model=booster, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=None)
+        for cb in cbs_before:
+            cb(env)
+        stopped = booster.update(fobj=fobj)
+        evals = []
+        if booster._valid_names or cfg.is_provide_training_metric:
+            if cfg.is_provide_training_metric:
+                evals.extend(booster.eval_train(feval))
+            evals.extend(booster.eval_valid(feval))
+        env = CallbackEnv(model=booster, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=evals)
+        try:
+            for cb in cbs_after:
+                cb(env)
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for (name, metric, value, _) in e.best_score:
+                booster.best_score.setdefault(name, {})[metric] = value
+            # roll back to best iteration for prediction default
+            break
+        if stopped:
+            break
+
+    if prev_booster is not None:
+        # merge: previous trees come first (continued training model)
+        booster.trees = prev_booster.trees + booster.trees
+        booster.tree_weights = (prev_booster.tree_weights
+                                + booster.tree_weights)
+    return booster
+
+
+def _dataset_raw(ds: Dataset):
+    if ds.raw_data is not None:
+        return ds.raw_data
+    if ds._raw_input is not None:
+        return ds._raw_input
+    raise ValueError("init_model needs the training data raw values "
+                     "(construct the Dataset with free_raw_data=False)")
+
+
+class CVBooster:
+    """Container of per-fold boosters (engine.py:264 analog)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, b: Booster) -> None:
+        self.boosters.append(b)
+
+    def __getattr__(self, name):
+        def _handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return _handler
+
+
+def _make_folds(ds: Dataset, nfold: int, stratified: bool, shuffle: bool,
+                seed: int, cfg: Config):
+    ds.construct(cfg)
+    n = ds.num_data
+    rng = np.random.RandomState(seed)
+    if ds.metadata.query_boundaries is not None:
+        # group-aware folds (engine.py _make_n_folds group handling)
+        sizes = np.diff(ds.metadata.query_boundaries)
+        q = len(sizes)
+        order = rng.permutation(q) if shuffle else np.arange(q)
+        folds_q = np.array_split(order, nfold)
+        starts = ds.metadata.query_boundaries[:-1]
+        for fq in folds_q:
+            test_rows = np.concatenate([
+                np.arange(starts[qi], starts[qi] + sizes[qi]) for qi in fq]) \
+                if len(fq) else np.array([], np.int64)
+            mask = np.zeros(n, bool)
+            mask[test_rows] = True
+            yield np.nonzero(~mask)[0], np.nonzero(mask)[0]
+        return
+    if stratified and cfg.objective in ("binary", "multiclass", "multiclassova"):
+        label = np.asarray(ds.metadata.label).astype(np.int64)
+        idx_by_class = [np.nonzero(label == c)[0] for c in np.unique(label)]
+        folds = [[] for _ in range(nfold)]
+        for idx in idx_by_class:
+            if shuffle:
+                idx = idx[rng.permutation(len(idx))]
+            for fi, part in enumerate(np.array_split(idx, nfold)):
+                folds[fi].append(part)
+        for fi in range(nfold):
+            test = np.concatenate(folds[fi])
+            mask = np.zeros(n, bool)
+            mask[test] = True
+            yield np.nonzero(~mask)[0], np.nonzero(mask)[0]
+        return
+    order = rng.permutation(n) if shuffle else np.arange(n)
+    for part in np.array_split(order, nfold):
+        mask = np.zeros(n, bool)
+        mask[part] = True
+        yield np.nonzero(~mask)[0], np.nonzero(mask)[0]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, feval=None, init_model=None,
+       seed: int = 0, callbacks=None, eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (engine.py:375 analog)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config(params)
+    if cfg.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = cfg.num_iterations
+    train_set.construct(cfg)
+
+    if folds is None:
+        folds = list(_make_folds(train_set, nfold, stratified, shuffle, seed, cfg))
+
+    cvbooster = CVBooster()
+    results = collections.defaultdict(list)
+    fold_results: List[Dict[str, List[float]]] = []
+    group = train_set.get_group()
+    for (tr_idx, te_idx) in folds:
+        tr = train_set.subset(tr_idx)
+        te = train_set.subset(te_idx)
+        if group is not None:
+            # rebuild per-fold group sizes from query boundaries
+            tr._group_from_parent(train_set, tr_idx)
+            te._group_from_parent(train_set, te_idx)
+        rec: Dict[str, Any] = {}
+        cb = list(callbacks or []) + [callback_mod.record_evaluation(rec)]
+        bst = train(params, tr, num_boost_round, valid_sets=[te],
+                    valid_names=["valid"], feval=feval, callbacks=cb)
+        cvbooster.append(bst)
+        fold_results.append(rec.get("valid", {}))
+
+    # aggregate mean/std per metric per iteration
+    if fold_results:
+        metric_names = fold_results[0].keys()
+        for mname in metric_names:
+            series = [fr[mname] for fr in fold_results if mname in fr]
+            rounds = min(len(s) for s in series)
+            arr = np.asarray([s[:rounds] for s in series])
+            results[f"valid {mname}-mean"] = list(arr.mean(axis=0))
+            results[f"valid {mname}-stdv"] = list(arr.std(axis=0))
+    out = dict(results)
+    if return_cvbooster:
+        cvbooster.best_iteration = max(b.best_iteration for b in cvbooster.boosters)
+        out["cvbooster"] = cvbooster
+    return out

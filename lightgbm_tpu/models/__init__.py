@@ -1,0 +1,1 @@
+from .gbdt import GBDTModel, create_boosting

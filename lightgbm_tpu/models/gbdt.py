@@ -1,0 +1,349 @@
+"""GBDT boosting driver.
+
+TPU-native analog of the reference GBDT
+(/root/reference/src/boosting/gbdt.cpp): iteration loop of
+gradient computation -> (bagging | GOSS sampling) -> per-class tree growth
+on device -> leaf renewal -> shrinkage -> score update (gbdt.cpp:371-449
+``TrainOneIter``).  Scores for train data are updated via the grower's
+row->leaf vector (no traversal); validation scores via device traversal
+(predict_device.py).  Model state (host ``Tree`` list) is serialized in the
+reference text format by the Booster layer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..grower import make_grower, TreeArrays
+from ..objectives import ObjectiveFunction
+from ..ops.split import SplitParams
+from ..predict_device import add_tree_score, round_up_pow2, traverse_tree_binned
+from ..tree_model import Tree
+
+
+class _DeviceTree:
+    """Per-tree device arrays for fast binned traversal."""
+
+    __slots__ = ("split_feature", "threshold_bin", "default_left",
+                 "left_child", "right_child", "leaf_value", "steps")
+
+    def __init__(self, arrays: TreeArrays, leaf_value: np.ndarray, steps: int):
+        self.split_feature = arrays.split_feature
+        self.threshold_bin = arrays.threshold_bin
+        self.default_left = arrays.default_left
+        self.left_child = arrays.left_child
+        self.right_child = arrays.right_child
+        self.leaf_value = jnp.asarray(leaf_value, jnp.float32)
+        self.steps = steps
+
+
+class GBDTModel:
+    """Boosting state machine (boosting.h:27-319 interface analog)."""
+
+    def __init__(self, config: Config, train_set: Dataset,
+                 objective: Optional[ObjectiveFunction],
+                 hist_reduce=None):
+        self.config = config
+        self.train_set = train_set.construct(config)
+        self.objective = objective
+        self.num_class = config.num_model_per_iteration
+        self.learning_rate = config.learning_rate
+        self.iter_ = 0
+
+        ds = self.train_set
+        self.num_data = ds.num_data
+        self.num_features = ds.num_features
+        if self.num_features == 0:
+            raise ValueError("Dataset has no usable (non-trivial) features")
+
+        # device-resident binned matrix + per-feature bin metadata
+        self.binned_dev = jnp.asarray(ds.binned)
+        num_bin = np.asarray([ds.bin_mappers[f].num_bin for f in ds.used_features],
+                             np.int32)
+        na_bin = np.asarray([ds.bin_mappers[f].na_bin for f in ds.used_features],
+                            np.int32)
+        self.num_bin_dev = jnp.asarray(num_bin)
+        self.na_bin_dev = jnp.asarray(na_bin)
+        self.max_bin = int(num_bin.max())
+
+        self.split_params = SplitParams(
+            lambda_l1=config.lambda_l1,
+            lambda_l2=config.lambda_l2,
+            min_data_in_leaf=config.min_data_in_leaf,
+            min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+            min_gain_to_split=config.min_gain_to_split,
+            max_delta_step=config.max_delta_step,
+            path_smooth=config.path_smooth,
+        )
+        self.grower = make_grower(
+            num_leaves=config.num_leaves, num_bins=self.max_bin,
+            params=self.split_params, max_depth=config.max_depth,
+            block_rows=config.rows_per_block, hist_reduce=hist_reduce)
+
+        if self.objective is not None:
+            self.objective.init(ds.metadata, self.num_data)
+
+        # scores: [N, K] f32 on device
+        init = np.zeros((self.num_data, self.num_class), np.float32)
+        if ds.metadata.init_score is not None:
+            s = np.asarray(ds.metadata.init_score, np.float32)
+            init += s.reshape(self.num_data, -1)
+        self.score = jnp.asarray(init)
+        self._init_applied = ds.metadata.init_score is not None
+
+        # validation sets: (dataset, device binned, score)
+        self.valid_sets: List[Tuple[Dataset, jax.Array, jax.Array]] = []
+
+        self.models: List[Tree] = []          # host trees, grouped per iter
+        self.device_trees: List[_DeviceTree] = []
+        self.tree_weights: List[float] = []   # DART/RF reweighting
+        self._rng_bag = np.random.RandomState(config.bagging_seed)
+        self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
+        self._bag_mask: Optional[np.ndarray] = None
+        self._goss = config.data_sample_strategy == "goss"
+        self._last_iter_state: Optional[dict] = None
+
+    # -- plumbing ----------------------------------------------------------
+    def add_valid_set(self, valid: Dataset) -> None:
+        valid.construct(self.config)
+        binned = jnp.asarray(valid.binned)
+        init = np.zeros((valid.num_data, self.num_class), np.float32)
+        if valid.metadata.init_score is not None:
+            init += np.asarray(valid.metadata.init_score, np.float32) \
+                .reshape(valid.num_data, -1)
+        score = jnp.asarray(init)
+        # replay existing trees (continued training)
+        for ti, dt in enumerate(self.device_trees):
+            k = ti % self.num_class
+            score = score.at[:, k].set(add_tree_score(
+                score[:, k], binned, dt.split_feature, dt.threshold_bin,
+                dt.default_left, dt.left_child, dt.right_child,
+                self.na_bin_dev, dt.leaf_value,
+                jnp.float32(self.tree_weights[ti]), steps=dt.steps))
+        self.valid_sets.append((valid, binned, score))
+
+    # -- sampling (gbdt.cpp:230 Bagging + goss.hpp) ------------------------
+    def _bagging_mask(self) -> Optional[np.ndarray]:
+        cfg = self.config
+        freq, frac = cfg.bagging_freq, cfg.bagging_fraction
+        pos_f, neg_f = cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
+        needs = freq > 0 and (frac < 1.0 or pos_f < 1.0 or neg_f < 1.0)
+        if not needs:
+            return None
+        if self.iter_ % freq == 0:
+            n = self.num_data
+            if (pos_f < 1.0 or neg_f < 1.0) and self.objective is not None \
+                    and self.objective.name == "binary":
+                lbl = np.asarray(self.train_set.metadata.label)
+                r = self._rng_bag.rand(n)
+                mask = np.where(lbl > 0, r < pos_f, r < neg_f)
+            else:
+                mask = self._rng_bag.rand(n) < frac
+            self._bag_mask = mask.astype(np.float32)
+        return self._bag_mask
+
+    def _goss_vals(self, g: jax.Array, h: jax.Array) -> jax.Array:
+        """GOSS (goss.hpp:20-188): keep top_rate by |grad|, sample
+        other_rate of the rest, amplify their weight."""
+        cfg = self.config
+        n = self.num_data
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        amp = (1.0 - cfg.top_rate) / cfg.other_rate
+        absg = jnp.abs(g) * h
+        thresh = -jnp.sort(-absg)[top_k - 1]
+        is_top = absg >= thresh
+        key = jax.random.PRNGKey(cfg.bagging_seed + self.iter_)
+        u = jax.random.uniform(key, (n,))
+        p_other = other_k / jnp.maximum(n - top_k, 1)
+        is_other = (~is_top) & (u < p_other)
+        w = jnp.where(is_top, 1.0, jnp.where(is_other, amp, 0.0))
+        return w.astype(jnp.float32)
+
+    def _feature_mask(self) -> np.ndarray:
+        frac = self.config.feature_fraction
+        f = self.num_features
+        if frac >= 1.0:
+            return np.ones(f, bool)
+        k = max(1, int(round(f * frac)))
+        idx = self._rng_feat.choice(f, size=k, replace=False)
+        mask = np.zeros(f, bool)
+        mask[idx] = True
+        return mask
+
+    # -- training ----------------------------------------------------------
+    _bias_in_every_tree = False   # RF overrides: init bias folded in each tree
+
+    def _score_for_gradients(self) -> jax.Array:
+        return self.score
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (gbdt.cpp:371 TrainOneIter).
+        Returns True if training should stop (no splits possible)."""
+        cfg = self.config
+        init_scores = [0.0] * self.num_class
+        if self.iter_ == 0 and self.objective is not None \
+                and cfg.boost_from_average and not self._init_applied:
+            # BoostFromAverage (gbdt.cpp:346): add init to train+valid
+            # scorers before gradient computation; the saved tree gets the
+            # bias via AddBias AFTER UpdateScore (gbdt.cpp:416-418)
+            for k in range(self.num_class):
+                init_scores[k] = self.objective.boost_from_score(k)
+            self._init_scores = list(init_scores)
+            if any(s != 0.0 for s in init_scores) and not self._bias_in_every_tree:
+                bias = jnp.asarray(init_scores, jnp.float32)
+                self.score = self.score + bias
+                for vi, (vds, vb, vs) in enumerate(self.valid_sets):
+                    self.valid_sets[vi] = (vds, vb, vs + bias)
+        # gradients (GBDT::Boosting, gbdt.cpp:172)
+        gscore = self._score_for_gradients()
+        if self._bias_in_every_tree:
+            init_scores = list(getattr(self, "_init_scores", init_scores))
+        if grad is None:
+            g_all, h_all = self.objective.get_gradients(
+                gscore[:, 0] if self.num_class == 1 else gscore)
+        else:
+            g_all = jnp.asarray(grad, jnp.float32)
+            h_all = jnp.asarray(hess, jnp.float32)
+        if self.num_class == 1:
+            g_all = g_all.reshape(self.num_data, 1)
+            h_all = h_all.reshape(self.num_data, 1)
+        else:
+            g_all = g_all.reshape(self.num_data, self.num_class)
+            h_all = h_all.reshape(self.num_data, self.num_class)
+
+        bag = self._bagging_mask()
+        fmask = jnp.asarray(self._feature_mask())
+
+        stopped = True
+        iter_trees: List[Tree] = []
+        iter_state = {"leaf_of_rows": [], "leaf_values": [], "trees": []}
+        for k in range(self.num_class):
+            g, h = g_all[:, k], h_all[:, k]
+            if self._goss:
+                w = self._goss_vals(g, h)
+            elif bag is not None:
+                w = jnp.asarray(bag)
+            else:
+                w = jnp.ones(self.num_data, jnp.float32)
+            vals = jnp.stack([g * w, h * w, w], axis=1)
+            arrays = self.grower(self.binned_dev, vals, fmask,
+                                 self.num_bin_dev, self.na_bin_dev)
+            nl = int(arrays.num_leaves)
+            leaf_values = np.asarray(arrays.leaf_value, np.float64).copy()
+            if nl <= 1:
+                leaf_values[:] = 0.0  # stump contributes nothing (gbdt.cpp warn)
+            else:
+                stopped = False
+                if self.objective is not None and \
+                        self.objective.need_renew_tree_output:
+                    # RenewTreeOutput (serial_tree_learner.cpp:717)
+                    score_np = np.asarray(self.score[:, k])
+                    leaf_values[:nl] = self.objective.renew_leaf_values(
+                        score_np, np.asarray(arrays.leaf_of_row), nl,
+                        leaf_values[:nl].copy())
+
+            shrinkage = 1.0 if cfg.boosting == "rf" else self.learning_rate
+            leaf_values *= shrinkage
+            # device trees carry UNBIASED values when the bias was already
+            # added to the scorers (gbdt); RF folds the bias into every tree
+            # (rf.hpp:137) so its device values include it too
+            bias = init_scores[k] if self._bias_in_every_tree else 0.0
+            dev_values = leaf_values + bias
+            host_values = leaf_values + init_scores[k]  # Tree::AddBias
+
+            # host tree
+            ht = Tree.from_arrays(arrays, self.train_set.used_features,
+                                  self.train_set.bin_mappers)
+            ht.leaf_value = host_values[:max(nl, 1)].copy()
+            ht.internal_value = ht.internal_value * shrinkage
+            ht.shrinkage = shrinkage
+            iter_trees.append(ht)
+
+            # score update via row->leaf gather (no traversal needed)
+            lv_dev = jnp.asarray(dev_values, jnp.float32)
+            delta = jnp.take(lv_dev, arrays.leaf_of_row)
+            self.score = self.score.at[:, k].add(delta)
+
+            steps = round_up_pow2(max(ht.max_depth(), 1))
+            dt = _DeviceTree(arrays, dev_values, steps)
+            self.device_trees.append(dt)
+            self.tree_weights.append(1.0)
+            iter_state["leaf_of_rows"].append(arrays.leaf_of_row)
+            iter_state["leaf_values"].append(lv_dev)
+            iter_state["trees"].append(dt)
+
+            # validation score updates
+            for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
+                ns = add_tree_score(
+                    vscore[:, k], vbinned, dt.split_feature, dt.threshold_bin,
+                    dt.default_left, dt.left_child, dt.right_child,
+                    self.na_bin_dev, dt.leaf_value, jnp.float32(1.0),
+                    steps=dt.steps)
+                self.valid_sets[vi] = (vds, vbinned, vscore.at[:, k].set(ns))
+
+        self.models.extend(iter_trees)
+        self._last_iter_state = iter_state
+        self.iter_ += 1
+        return stopped
+
+    def rollback_one_iter(self) -> None:
+        """GBDT::RollbackOneIter (gbdt.cpp:451)."""
+        if self.iter_ == 0 or self._last_iter_state is None:
+            return
+        st = self._last_iter_state
+        for k in range(self.num_class):
+            delta = jnp.take(st["leaf_values"][k], st["leaf_of_rows"][k])
+            self.score = self.score.at[:, k].add(-delta)
+            dt = st["trees"][k]
+            for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
+                ns = add_tree_score(
+                    vscore[:, k], vbinned, dt.split_feature, dt.threshold_bin,
+                    dt.default_left, dt.left_child, dt.right_child,
+                    self.na_bin_dev, dt.leaf_value, jnp.float32(-1.0),
+                    steps=dt.steps)
+                self.valid_sets[vi] = (vds, vbinned, vscore.at[:, k].set(ns))
+        del self.models[-self.num_class:]
+        del self.device_trees[-self.num_class:]
+        del self.tree_weights[-self.num_class:]
+        self.iter_ -= 1
+        self._last_iter_state = None
+
+    # -- scores ------------------------------------------------------------
+    @property
+    def num_iterations_trained(self) -> int:
+        return self.iter_
+
+    def train_score(self) -> np.ndarray:
+        s = np.asarray(self.score)
+        if self.config.boosting == "rf" and self.iter_ > 0:
+            s = s / self.iter_
+        return s
+
+    def valid_score(self, i: int) -> np.ndarray:
+        s = np.asarray(self.valid_sets[i][2])
+        if self.config.boosting == "rf" and self.iter_ > 0:
+            s = s / self.iter_
+        return s
+
+
+def create_boosting(config: Config, train_set: Dataset,
+                    objective, hist_reduce=None) -> GBDTModel:
+    """Boosting factory (boosting.cpp:35-68 CreateBoosting analog)."""
+    if config.boosting in ("gbdt", "gbrt"):
+        return GBDTModel(config, train_set, objective, hist_reduce)
+    if config.boosting == "dart":
+        from .dart import DARTModel
+        return DARTModel(config, train_set, objective, hist_reduce)
+    if config.boosting in ("rf", "random_forest"):
+        from .rf import RFModel
+        return RFModel(config, train_set, objective, hist_reduce)
+    raise ValueError(f"Unknown boosting type: {config.boosting}")

@@ -1,0 +1,63 @@
+"""Device-side tree traversal over binned data.
+
+Used for validation-set score updates each iteration (the reference's
+``ScoreUpdater::AddScore(tree)`` path, score_updater.hpp:21-128) and for
+batched leaf prediction.  The traversal is a fixed-depth ``fori_loop`` of
+vectorized gathers: every row walks one level per step; finished rows carry
+their (negative-encoded) leaf id unchanged — static shapes, no divergence.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
+                         left_child, right_child, na_bin, *, steps: int):
+    """Return the leaf index for every row of ``binned`` [N, F].
+
+    Tree arrays are the grower's (bin-space thresholds: go left iff
+    bin <= threshold, NaN-bin rows follow ``default_left``).
+    ``steps`` must be >= tree depth.
+    """
+    n = binned.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+
+    def body(_, node):
+        internal = node >= 0
+        nid = jnp.maximum(node, 0)
+        f = split_feature[nid]
+        v = jnp.take_along_axis(binned, f[:, None].astype(jnp.int32),
+                                axis=1)[:, 0].astype(jnp.int32)
+        nb = na_bin[f]
+        is_na = (nb >= 0) & (v == nb)
+        go_left = jnp.where(is_na, default_left[nid], v <= threshold_bin[nid])
+        nxt = jnp.where(go_left, left_child[nid], right_child[nid])
+        return jnp.where(internal, nxt, node)
+
+    node = lax.fori_loop(0, steps, body, node)
+    return (~node).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def add_tree_score(score, binned, split_feature, threshold_bin, default_left,
+                   left_child, right_child, na_bin, leaf_value, weight,
+                   *, steps: int):
+    """score += weight * tree(binned) — incremental ScoreUpdater step."""
+    leaf = traverse_tree_binned(binned, split_feature, threshold_bin,
+                                default_left, left_child, right_child,
+                                na_bin, steps=steps)
+    return score + weight * jnp.take(leaf_value, leaf)
+
+
+def round_up_pow2(x: int) -> int:
+    """Bucket traversal depth to limit jit-cache entries."""
+    p = 1
+    while p < x:
+        p *= 2
+    return p

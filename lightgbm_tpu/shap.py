@@ -1,0 +1,154 @@
+"""SHAP feature contributions (TreeSHAP).
+
+Analog of the reference ``Tree::PredictContrib`` / per-path Shapley
+(/root/reference/include/LightGBM/tree.h:666, src/io/tree.cpp): the
+polynomial-time TreeSHAP recursion with EXTEND/UNWIND path bookkeeping.
+Host-side NumPy implementation; output layout matches the reference
+(``[n_features + 1]`` per example per class, last column = expected value).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class _Path:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, depth: int):
+        self.feature_index = np.zeros(depth, np.int32)
+        self.zero_fraction = np.zeros(depth, np.float64)
+        self.one_fraction = np.zeros(depth, np.float64)
+        self.pweight = np.zeros(depth, np.float64)
+
+
+def _extend(p: _Path, length: int, zero_frac: float, one_frac: float,
+            fidx: int) -> None:
+    p.feature_index[length] = fidx
+    p.zero_fraction[length] = zero_frac
+    p.one_fraction[length] = one_frac
+    p.pweight[length] = 1.0 if length == 0 else 0.0
+    for i in range(length - 1, -1, -1):
+        p.pweight[i + 1] += one_frac * p.pweight[i] * (i + 1) / (length + 1)
+        p.pweight[i] = zero_frac * p.pweight[i] * (length - i) / (length + 1)
+
+
+def _unwind(p: _Path, length: int, index: int) -> None:
+    one = p.one_fraction[index]
+    zero = p.zero_fraction[index]
+    n = p.pweight[length]
+    for i in range(length - 1, -1, -1):
+        if one != 0.0:
+            t = p.pweight[i]
+            p.pweight[i] = n * (length + 1) / ((i + 1) * one)
+            n = t - p.pweight[i] * zero * (length - i) / (length + 1)
+        else:
+            p.pweight[i] = p.pweight[i] * (length + 1) / (zero * (length - i))
+    for i in range(index, length):
+        p.feature_index[i] = p.feature_index[i + 1]
+        p.zero_fraction[i] = p.zero_fraction[i + 1]
+        p.one_fraction[i] = p.one_fraction[i + 1]
+
+
+def _unwound_sum(p: _Path, length: int, index: int) -> float:
+    one = p.one_fraction[index]
+    zero = p.zero_fraction[index]
+    total = 0.0
+    n = p.pweight[length]
+    for i in range(length - 1, -1, -1):
+        if one != 0.0:
+            t = n * (length + 1) / ((i + 1) * one)
+            total += t
+            n = p.pweight[i] - t * zero * (length - i) / (length + 1)
+        else:
+            total += p.pweight[i] / (zero * (length - i) / (length + 1))
+    return total
+
+
+def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int, depth: int,
+               p: _Path, parent_zero: float, parent_one: float,
+               parent_fidx: int) -> None:
+    # copy parent path
+    q = _Path(depth + 4)
+    q.feature_index[:depth + 1] = p.feature_index[:depth + 1]
+    q.zero_fraction[:depth + 1] = p.zero_fraction[:depth + 1]
+    q.one_fraction[:depth + 1] = p.one_fraction[:depth + 1]
+    q.pweight[:depth + 1] = p.pweight[:depth + 1]
+    _extend(q, depth, parent_zero, parent_one, parent_fidx)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        w = tree.leaf_value[leaf]
+        for i in range(1, depth + 1):
+            total = _unwound_sum(q, depth, i)
+            phi[q.feature_index[i]] += total * (q.one_fraction[i]
+                                                - q.zero_fraction[i]) * w
+        return
+
+    f = int(tree.split_feature[node])
+    go_left = bool(tree._decide(node, x[f:f + 1])[0])
+    hot = tree.left_child[node] if go_left else tree.right_child[node]
+    cold = tree.right_child[node] if go_left else tree.left_child[node]
+    w_node = float(tree.internal_count[node]) or 1.0
+    hot_cnt = (float(tree.leaf_count[~hot]) if hot < 0
+               else float(tree.internal_count[hot]))
+    cold_cnt = (float(tree.leaf_count[~cold]) if cold < 0
+                else float(tree.internal_count[cold]))
+    hot_frac = hot_cnt / w_node
+    cold_frac = cold_cnt / w_node
+
+    # undo duplicated feature on the path
+    incoming_zero, incoming_one = 1.0, 1.0
+    path_idx = -1
+    for i in range(1, depth + 1):
+        if q.feature_index[i] == f:
+            path_idx = i
+            break
+    if path_idx >= 0:
+        incoming_zero = q.zero_fraction[path_idx]
+        incoming_one = q.one_fraction[path_idx]
+        _unwind(q, depth, path_idx)
+        depth -= 1
+
+    _tree_shap(tree, x, phi, hot, depth + 1, q,
+               hot_frac * incoming_zero, incoming_one, f)
+    _tree_shap(tree, x, phi, cold, depth + 1, q,
+               cold_frac * incoming_zero, 0.0, f)
+
+
+def tree_contrib(tree, x: np.ndarray) -> np.ndarray:
+    """SHAP values of one tree for one example; [-1] is the base value."""
+    nf = int(tree.split_feature.max()) + 1 if tree.num_nodes() > 0 else 0
+    phi = np.zeros(max(nf, len(x)) + 1)
+    if tree.num_leaves <= 1:
+        phi[-1] += tree.leaf_value[0]
+        return phi[:len(x) + 1]
+    # expected value = count-weighted mean of leaves
+    total = tree.leaf_count.sum()
+    phi_base = float((tree.leaf_value * tree.leaf_count).sum() / max(total, 1))
+    phi[-1] = phi_base
+    p = _Path(4)
+    _tree_shap(tree, x, phi, 0, 0, p, 1.0, 1.0, -1)
+    out = np.zeros(len(x) + 1)
+    out[:min(len(phi) - 1, len(x))] = phi[:min(len(phi) - 1, len(x))]
+    out[-1] = phi[-1]
+    return out
+
+
+def predict_contrib(booster, x: np.ndarray, t0: int, t1: int) -> np.ndarray:
+    """Booster-level SHAP (LGBM_BoosterPredictForMat + predict_contrib)."""
+    n, nf = x.shape
+    k = booster._num_tree_per_iteration
+    out = np.zeros((n, k, nf + 1))
+    for ti in range(t0, t1):
+        t = booster.trees[ti]
+        w = booster.tree_weights[ti]
+        for i in range(n):
+            out[i, ti % k] += w * tree_contrib(t, x[i])
+    if booster._average_output and t1 > t0:
+        out /= (t1 - t0) // k
+    if k == 1:
+        return out[:, 0, :]
+    return out.reshape(n, k * (nf + 1))

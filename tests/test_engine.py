@@ -1,0 +1,258 @@
+"""End-to-end training tests (test_engine.py analog, SURVEY.md §4):
+objective families, quality thresholds on synthetic data, early stopping,
+callbacks, model round-trips.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.metrics import _auc
+
+
+def _train_binary(x, y, params=None, rounds=30, valid=None):
+    p = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+         "max_bin": 63, "min_data_in_leaf": 5, "verbosity": 0}
+    p.update(params or {})
+    ds = lgb.Dataset(x, label=y)
+    vs = [lgb.Dataset(v[0], label=v[1], reference=ds) for v in (valid or [])]
+    return lgb.train(p, ds, num_boost_round=rounds, valid_sets=vs or None)
+
+
+class TestBinary:
+    def test_auc_quality(self, binary_data):
+        x, y = binary_data
+        bst = _train_binary(x[:3000], y[:3000])
+        pred = bst.predict(x[3000:], raw_score=True)
+        auc = _auc(y[3000:], pred, None)
+        assert auc > 0.97, f"AUC too low: {auc}"
+
+    def test_predict_probability(self, binary_data):
+        x, y = binary_data
+        bst = _train_binary(x, y, rounds=10)
+        p = bst.predict(x[:100])
+        assert (p >= 0).all() and (p <= 1).all()
+        raw = bst.predict(x[:100], raw_score=True)
+        np.testing.assert_allclose(p, 1 / (1 + np.exp(-raw)), rtol=1e-5)
+
+    def test_eval_improves(self, binary_data):
+        x, y = binary_data
+        rec = {}
+        p = {"objective": "binary", "num_leaves": 15, "metric": ["binary_logloss"],
+             "max_bin": 63, "min_data_in_leaf": 5}
+        ds = lgb.Dataset(x[:3000], label=y[:3000])
+        vds = lgb.Dataset(x[3000:], label=y[3000:], reference=ds)
+        lgb.train(p, ds, num_boost_round=20, valid_sets=[vds],
+                  callbacks=[lgb.record_evaluation(rec)])
+        ll = rec["valid_0"]["binary_logloss"]
+        assert len(ll) == 20
+        assert ll[-1] < ll[0] * 0.7
+
+    def test_early_stopping(self, binary_data):
+        x, y = binary_data
+        rs = np.random.RandomState(9)
+        y_noise = rs.permutation(y[3000:])  # uninformative valid labels
+        p = {"objective": "binary", "num_leaves": 31, "metric": ["auc"],
+             "max_bin": 63, "early_stopping_round": 3}
+        ds = lgb.Dataset(x[:3000], label=y[:3000])
+        vds = lgb.Dataset(x[3000:], label=y_noise, reference=ds)
+        bst = lgb.train(p, ds, num_boost_round=100, valid_sets=[vds])
+        assert bst.best_iteration > 0
+        assert bst.current_iteration < 100
+
+    def test_weights_respected(self, binary_data):
+        x, y = binary_data
+        w = np.where(y > 0, 10.0, 1.0)
+        bst = _train_binary(x, y, rounds=10)
+        ds = lgb.Dataset(x, label=y, weight=w)
+        bstw = lgb.train({"objective": "binary", "num_leaves": 15,
+                          "max_bin": 63}, ds, num_boost_round=10)
+        # heavier positive weight pushes predictions up
+        assert bstw.predict(x).mean() > bst.predict(x).mean()
+
+
+class TestRegression:
+    def test_l2_quality(self, regression_data):
+        x, y = regression_data
+        p = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+             "learning_rate": 0.1, "min_data_in_leaf": 5}
+        ds = lgb.Dataset(x[:3000], label=y[:3000])
+        bst = lgb.train(p, ds, num_boost_round=60)
+        pred = bst.predict(x[3000:])
+        mse = float(np.mean((pred - y[3000:]) ** 2))
+        var = float(np.var(y[3000:]))
+        assert mse < 0.4 * var, f"MSE {mse} vs var {var}"
+
+    def test_l1_median_renewal(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2000, 5)
+        y = x[:, 0] + 0.05 * rs.randn(2000)
+        p = {"objective": "regression_l1", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=40)
+        mae = float(np.mean(np.abs(bst.predict(x) - y)))
+        assert mae < 0.5 * np.mean(np.abs(y - np.median(y)))
+
+    @pytest.mark.parametrize("obj", ["huber", "fair", "quantile", "mape"])
+    def test_robust_objectives_run(self, obj, regression_data):
+        x, y = regression_data
+        p = {"objective": obj, "num_leaves": 7, "max_bin": 31}
+        bst = lgb.train(p, lgb.Dataset(x[:1000], label=y[:1000]),
+                        num_boost_round=5)
+        assert np.isfinite(bst.predict(x[:50])).all()
+
+    @pytest.mark.parametrize("obj", ["poisson", "gamma", "tweedie"])
+    def test_positive_objectives(self, obj):
+        rs = np.random.RandomState(1)
+        x = rs.randn(1500, 5)
+        y = np.exp(0.5 * x[:, 0] + 0.1 * rs.randn(1500))
+        p = {"objective": obj, "num_leaves": 7, "max_bin": 31}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        pred = bst.predict(x[:100])
+        assert (pred > 0).all()
+
+
+class TestMulticlass:
+    def test_softmax_quality(self):
+        rs = np.random.RandomState(2)
+        n = 3000
+        x = rs.randn(n, 8)
+        y = (x[:, 0] > 0.5).astype(int) + (x[:, 1] > 0).astype(int)
+        p = {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+             "max_bin": 63, "min_data_in_leaf": 5}
+        bst = lgb.train(p, lgb.Dataset(x[:2000], label=y[:2000]),
+                        num_boost_round=30)
+        pred = bst.predict(x[2000:])
+        assert pred.shape == (1000, 3)
+        np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+        acc = (pred.argmax(axis=1) == y[2000:]).mean()
+        assert acc > 0.85, f"accuracy {acc}"
+
+    def test_ova(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(1500, 5)
+        y = (x[:, 0] > 0).astype(int) * 2 + (x[:, 1] > 0).astype(int) * 0
+        y = np.clip(y, 0, 2)
+        p = {"objective": "multiclassova", "num_class": 3, "num_leaves": 7,
+             "max_bin": 31}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        pred = bst.predict(x[:100])
+        assert pred.shape == (100, 3)
+
+
+class TestModelIO:
+    def test_save_load_roundtrip(self, binary_data, tmp_path):
+        x, y = binary_data
+        bst = _train_binary(x, y, rounds=15)
+        path = str(tmp_path / "model.txt")
+        bst.save_model(path)
+        bst2 = lgb.Booster(model_file=path)
+        p1 = bst.predict(x[:500], raw_score=True)
+        p2 = bst2.predict(x[:500], raw_score=True)
+        np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-10)
+
+    def test_model_string_roundtrip(self, regression_data):
+        x, y = regression_data
+        p = {"objective": "regression", "num_leaves": 7, "max_bin": 31}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=8)
+        s = bst.model_to_string()
+        assert "tree" in s and "end of trees" in s
+        bst2 = lgb.Booster.model_from_string(s)
+        np.testing.assert_allclose(bst.predict(x[:200]), bst2.predict(x[:200]),
+                                   rtol=1e-6, atol=1e-10)
+
+    def test_missing_values_in_predict(self):
+        rs = np.random.RandomState(5)
+        x = rs.randn(2000, 4)
+        x[rs.rand(2000) < 0.2, 1] = np.nan
+        y = (np.nan_to_num(x[:, 1], nan=2.0) > 0).astype(np.float32)
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "min_data_in_leaf": 5}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        xt = x[:100].copy()
+        xt[:, 1] = np.nan
+        pred = bst.predict(xt)
+        assert np.isfinite(pred).all()
+        # NaN rows should predict like the high-label group
+        assert pred.mean() > 0.5
+
+
+class TestSampling:
+    def test_bagging(self, binary_data):
+        x, y = binary_data
+        bst = _train_binary(x, y, params={"bagging_fraction": 0.5,
+                                          "bagging_freq": 1}, rounds=15)
+        pred = bst.predict(x, raw_score=True)
+        assert _auc(y, pred, None) > 0.9
+
+    def test_goss(self, binary_data):
+        x, y = binary_data
+        bst = _train_binary(x, y, params={"data_sample_strategy": "goss"},
+                            rounds=15)
+        assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9
+
+    def test_feature_fraction(self, binary_data):
+        x, y = binary_data
+        bst = _train_binary(x, y, params={"feature_fraction": 0.6}, rounds=15)
+        assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9
+
+
+class TestBoostingVariants:
+    def test_dart(self, binary_data):
+        x, y = binary_data
+        bst = _train_binary(x, y, params={"boosting": "dart",
+                                          "drop_rate": 0.2}, rounds=15)
+        assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9
+
+    def test_rf(self, binary_data):
+        x, y = binary_data
+        bst = _train_binary(x, y, params={"boosting": "rf",
+                                          "bagging_fraction": 0.7,
+                                          "bagging_freq": 1}, rounds=10)
+        assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9
+
+
+class TestCustomObjective:
+    def test_fobj_feval(self, binary_data):
+        x, y = binary_data
+        ds = lgb.Dataset(x, label=y, params={"max_bin": 63})
+
+        def fobj(preds, dataset):
+            p = 1 / (1 + np.exp(-preds))
+            return p - y, p * (1 - p)
+
+        def feval(preds, dataset):
+            p = 1 / (1 + np.exp(-preds))
+            return ("my_err", float(np.mean((p > 0.5) != y)), False)
+
+        p = {"objective": "custom", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5}
+        bst = lgb.train(p, ds, num_boost_round=15, fobj=fobj, feval=feval)
+        pred = bst.predict(x, raw_score=True)
+        assert _auc(y, pred, None) > 0.95
+
+
+class TestCV:
+    def test_cv_binary(self, binary_data):
+        x, y = binary_data
+        res = lgb.cv({"objective": "binary", "num_leaves": 7, "max_bin": 31,
+                      "metric": ["auc"]},
+                     lgb.Dataset(x[:2000], label=y[:2000]),
+                     num_boost_round=5, nfold=3)
+        assert "valid auc-mean" in res
+        assert len(res["valid auc-mean"]) == 5
+        assert res["valid auc-mean"][-1] > 0.8
+
+
+class TestContinuedTraining:
+    def test_init_model(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31}
+        ds1 = lgb.Dataset(x, label=y, free_raw_data=False)
+        bst1 = lgb.train(p, ds1, num_boost_round=5)
+        ds2 = lgb.Dataset(x, label=y, free_raw_data=False)
+        bst2 = lgb.train(p, ds2, num_boost_round=5, init_model=bst1)
+        assert bst2.num_trees() == 10
+        auc1 = _auc(y, bst1.predict(x, raw_score=True), None)
+        auc2 = _auc(y, bst2.predict(x, raw_score=True), None)
+        assert auc2 >= auc1 - 1e-6
