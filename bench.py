@@ -1,0 +1,79 @@
+"""Benchmark: HIGGS-shaped binary classification training throughput.
+
+Mirrors the reference's headline experiment (docs/Experiments.rst: HIGGS,
+500 iterations, num_leaves=255 -> 130.094 s on 2x E5-2690v4, i.e. 3.843
+iters/s; GPU docs recommend 63 bins for accelerator runs,
+docs/GPU-Performance.rst:108-124).  This round benches a 1M-row slice of
+that shape at num_leaves=31, max_bin=63; ``vs_baseline`` is our steady-state
+iters/s over the reference's full-size 3.843 iters/s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_higgs_like(n: int, f: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float32)
+    logit = (1.2 * x[:, 0] - 0.8 * x[:, 1] + 0.6 * x[:, 2] * x[:, 3]
+             + 0.4 * np.abs(x[:, 4]) + 0.5 * rng.randn(n))
+    y = (logit > 0).astype(np.float32)
+    return x, y
+
+
+def main():
+    n, f = 1_000_000, 28
+    iters = 100
+    x, y = make_higgs_like(n, f)
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.metrics import _auc
+
+    params = {
+        "objective": "binary",
+        "num_leaves": 31,
+        "learning_rate": 0.1,
+        "max_bin": 63,
+        "min_data_in_leaf": 20,
+        "verbosity": 0,
+    }
+    t_bin0 = time.time()
+    ds = lgb.Dataset(x, label=y)
+    ds.construct()
+    t_bin = time.time() - t_bin0
+
+    bst = lgb.Booster(params=params, train_set=ds)
+    # warmup: first iteration includes XLA compilation
+    t0 = time.time()
+    bst.update()
+    t_compile = time.time() - t0
+
+    t1 = time.time()
+    for _ in range(iters - 1):
+        bst.update()
+    # force device sync
+    np.asarray(bst._model.score)
+    dt = time.time() - t1
+    ips = (iters - 1) / dt
+
+    auc = _auc(y, np.asarray(bst._model.train_score())[:, 0], None)
+    print(f"[bench] bin={t_bin:.1f}s compile+iter1={t_compile:.1f}s "
+          f"steady={dt:.1f}s for {iters-1} iters -> {ips:.2f} iters/s "
+          f"train-AUC={auc:.4f}", file=sys.stderr)
+
+    baseline_ips = 500.0 / 130.094  # reference HIGGS CPU (Experiments.rst:113)
+    print(json.dumps({
+        "metric": "higgs1m_binary_train_iters_per_sec",
+        "value": round(ips, 3),
+        "unit": "iters/s (1M rows x 28 feat, 31 leaves, 63 bins)",
+        "vs_baseline": round(ips / baseline_ips, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

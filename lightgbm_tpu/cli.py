@@ -1,0 +1,160 @@
+"""Command-line application: config-file driven train / predict / refit.
+
+Analog of the reference Application layer
+(/root/reference/src/application/application.cpp:31-269 task dispatch +
+src/main.cpp): ``python -m lightgbm_tpu config=train.conf [key=value ...]``
+with the reference's config-file syntax (``key = value``, ``#`` comments).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from .booster import Booster
+from .config import Config, kv2map, load_config_file
+from .data_io import load_text
+from .dataset import Dataset
+from .engine import train as train_fn
+from . import callback as cb
+
+
+def _load_params(argv: List[str]) -> Dict[str, str]:
+    params = kv2map(argv)
+    conf_path = params.pop("config", params.pop("config_file", None))
+    if conf_path:
+        file_params = load_config_file(conf_path)
+        file_params.update(params)   # CLI overrides file (application.cpp:50)
+        params = file_params
+    return params
+
+
+def run(argv: List[str]) -> int:
+    params = _load_params(argv)
+    cfg = Config(params)
+    task = cfg.task
+    if task == "train":
+        return _task_train(cfg, params)
+    if task in ("predict", "prediction", "test"):
+        return _task_predict(cfg, params)
+    if task == "refit":
+        return _task_refit(cfg, params)
+    if task == "save_binary":
+        return _task_save_binary(cfg, params)
+    print(f"Unknown task: {task}", file=sys.stderr)
+    return 1
+
+
+def _load_dataset(cfg: Config, path: str, params: Dict,
+                  reference=None) -> Dataset:
+    if path.endswith(".npz") or path.endswith(".bin"):
+        return Dataset.load_binary(path)
+    x, y = load_text(path, has_header=cfg.header,
+                     label_column=cfg.label_column)
+    return Dataset(x, label=y, params=params, reference=reference)
+
+
+def _task_train(cfg: Config, params: Dict) -> int:
+    t0 = time.time()
+    train_set = _load_dataset(cfg, cfg.data, params)
+    valid_sets, valid_names = [], []
+    for i, vpath in enumerate(cfg.valid or []):
+        valid_sets.append(_load_dataset(cfg, str(vpath), params,
+                                        reference=train_set))
+        valid_names.append(f"valid_{i}")
+    callbacks = []
+    if cfg.verbosity > 0 and cfg.metric_freq > 0:
+        callbacks.append(cb.log_evaluation(cfg.metric_freq))
+    if cfg.is_provide_training_metric:
+        params.setdefault("is_provide_training_metric", True)
+    init_model = cfg.input_model or None
+    booster = train_fn(params, train_set, num_boost_round=cfg.num_iterations,
+                       valid_sets=valid_sets or None,
+                       valid_names=valid_names or None,
+                       init_model=init_model, callbacks=callbacks)
+    booster.save_model(cfg.output_model)
+    print(f"Finished training in {time.time() - t0:.2f} seconds; "
+          f"model saved to {cfg.output_model}")
+    if cfg.save_binary:
+        train_set.save_binary(cfg.data + ".bin.npz")
+    return 0
+
+
+def _task_predict(cfg: Config, params: Dict) -> int:
+    booster = Booster(model_file=cfg.input_model)
+    x, _ = load_text(cfg.data, has_header=cfg.header,
+                     label_column=cfg.label_column)
+    pred = booster.predict(
+        x, raw_score=cfg.predict_raw_score,
+        pred_leaf=cfg.predict_leaf_index, pred_contrib=cfg.predict_contrib,
+        start_iteration=cfg.start_iteration_predict,
+        num_iteration=cfg.num_iteration_predict)
+    np.savetxt(cfg.output_result, np.asarray(pred), delimiter="\t", fmt="%g")
+    print(f"Saved predictions to {cfg.output_result}")
+    return 0
+
+
+def _task_refit(cfg: Config, params: Dict) -> int:
+    booster = Booster(model_file=cfg.input_model)
+    x, y = load_text(cfg.data, has_header=cfg.header,
+                     label_column=cfg.label_column)
+    refit_booster = refit(booster, x, y, cfg)
+    refit_booster.save_model(cfg.output_model)
+    print(f"Refit model saved to {cfg.output_model}")
+    return 0
+
+
+def _task_save_binary(cfg: Config, params: Dict) -> int:
+    ds = _load_dataset(cfg, cfg.data, params)
+    ds.construct(cfg)
+    out = cfg.data + ".bin.npz"
+    ds.save_binary(out)
+    print(f"Saved binary dataset to {out}")
+    return 0
+
+
+def refit(booster: Booster, x: np.ndarray, y: np.ndarray,
+          cfg: Config) -> Booster:
+    """Re-fit leaf values of an existing structure on new data
+    (GBDT::RefitTree, gbdt.cpp:287-323): per tree, route rows to leaves,
+    recompute the regularized optimal output from the new gradients, and
+    blend with ``refit_decay_rate``."""
+    from .objectives import create_objective
+    obj = create_objective(booster.config)
+    from .dataset import Metadata
+    md = Metadata(len(y))
+    md.set_label(y)
+    obj.init(md, len(y))
+    k = booster._num_tree_per_iteration
+    import jax.numpy as jnp
+    score = np.zeros((len(y), k), np.float64)
+    decay = cfg.refit_decay_rate
+    lam = booster.config.lambda_l2
+    for ti, tree in enumerate(booster.trees):
+        kk = ti % k
+        g, h = obj.get_gradients(jnp.asarray(score[:, kk], jnp.float32)
+                                 if k == 1 else jnp.asarray(score, jnp.float32))
+        g = np.asarray(g).reshape(len(y), -1)[:, kk]
+        h = np.asarray(h).reshape(len(y), -1)[:, kk]
+        leaves = tree.predict_leaf(x)
+        for leaf in range(tree.num_leaves):
+            m = leaves == leaf
+            if not m.any():
+                continue
+            new_out = -g[m].sum() / (h[m].sum() + lam)
+            tree.leaf_value[leaf] = (decay * tree.leaf_value[leaf]
+                                     + (1.0 - decay) * new_out
+                                     * tree.shrinkage)
+        score[:, kk] += tree.leaf_value[leaves]
+    return booster
+
+
+def main() -> int:
+    return run(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

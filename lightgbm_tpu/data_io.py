@@ -1,0 +1,99 @@
+"""Text data loading: CSV / TSV / LibSVM with auto-detection.
+
+Analog of the reference Parser layer
+(/root/reference/src/io/parser.hpp:18-93 CSVParser/TSVParser/LibSVMParser +
+``Parser::CreateParser`` auto-detect, src/io/parser.cpp).  A native C++
+fast path (lightgbm_tpu/native/parser.cpp, loaded via ctypes) accelerates
+large files; this module is the API and NumPy fallback.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .native import native_parse_csv
+
+
+def detect_format(path: str, has_header: bool = False) -> str:
+    """Sniff csv/tsv/libsvm from the first data line (parser.cpp
+    auto-detect analog)."""
+    with open(path) as f:
+        line = f.readline()
+        if has_header:
+            line = f.readline()
+    if ":" in line.split()[1] if len(line.split()) > 1 else False:
+        return "libsvm"
+    first_tokens = line.strip().split("\t")
+    if len(first_tokens) > 1:
+        return "tsv"
+    if "," in line:
+        return "csv"
+    # space separated libsvm check: tokens after first contain ':'
+    toks = line.strip().split()
+    if len(toks) > 1 and all(":" in t for t in toks[1:3]):
+        return "libsvm"
+    return "csv"
+
+
+def load_text(path: str, has_header: bool = False,
+              label_column: str = "", fmt: Optional[str] = None
+              ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Load a text data file -> (features [N, F], label [N] or None).
+
+    Default label column is the first (reference convention,
+    dataset_loader.cpp label_idx_=0).
+    """
+    fmt = fmt or detect_format(path, has_header)
+    if fmt == "libsvm":
+        return _load_libsvm(path)
+    delim = "\t" if fmt == "tsv" else ","
+    native = native_parse_csv(path, delim, has_header)
+    if native is not None:
+        data = native
+    else:
+        data = np.genfromtxt(path, delimiter=delim,
+                             skip_header=1 if has_header else 0,
+                             dtype=np.float64)
+        if data.ndim == 1:
+            data = data.reshape(-1, 1)
+    label_idx = 0
+    if label_column.startswith("name:"):
+        if not has_header:
+            raise ValueError("label_column by name requires header=true")
+        with open(path) as f:
+            names = f.readline().strip().split(delim)
+        label_idx = names.index(label_column[5:])
+    elif label_column:
+        label_idx = int(label_column)
+    if data.shape[1] < 2:
+        return data, None
+    y = data[:, label_idx].astype(np.float32)
+    x = np.delete(data, label_idx, axis=1)
+    return x, y
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    labels, rows, max_feat = [], [], -1
+    with open(path) as f:
+        for line in f:
+            toks = line.strip().split()
+            if not toks:
+                continue
+            labels.append(float(toks[0]))
+            feats = {}
+            for t in toks[1:]:
+                if ":" not in t:
+                    continue
+                k, v = t.split(":", 1)
+                k = int(k)
+                feats[k] = float(v)
+                max_feat = max(max_feat, k)
+            rows.append(feats)
+    x = np.zeros((len(rows), max_feat + 1), np.float64)
+    for i, feats in enumerate(rows):
+        for k, v in feats.items():
+            x[i, k] = v
+    return x, np.asarray(labels, np.float32)

@@ -90,33 +90,54 @@ class _GrowState(NamedTuple):
 def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 max_depth: int = -1, block_rows: int = 0,
                 hist_reduce: Optional[Callable] = None,
-                donate_leaf_of_row: bool = False):
-    """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin)``.
+                hist_view: Optional[Callable] = None,
+                select_best: Optional[Callable] = None,
+                jit: bool = True):
+    """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
+    na_bin_part=None)``.
 
     vals: [N, 3] f32 = (grad, hess, in-bag weight); out-of-bag rows zeroed.
+
+    Parallelism hooks (SURVEY.md §2.6 strategies map onto one program):
+    - hist_reduce: reduce local histograms across the mesh row axis
+      (data-parallel psum; identity for serial).
+    - hist_view:   restrict the binned matrix to this shard's feature slice
+      before histogram work (feature-parallel; identity for serial).
+      ``feature_mask``/``num_bin``/``na_bin`` must then be the local slices,
+      while ``na_bin_part`` carries the global array for row partitioning.
+    - select_best: cross-shard reduction of a SplitResult (feature-parallel
+      argmax + feature-index globalization; identity for serial).
     """
     L = int(num_leaves)
     B = int(num_bins)
     reduce_fn = hist_reduce or (lambda h: h)
+    view_fn = hist_view or (lambda b: b)
+    select_fn = select_best or (lambda r: r)
 
-    def _hist(binned, vals):
-        h = compute_histogram(binned, vals, num_bins=B, block_rows=block_rows)
+    def _hist(binned_view, vals):
+        h = compute_histogram(binned_view, vals, num_bins=B,
+                              block_rows=block_rows)
         return reduce_fn(h)
 
     def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2):
         return jax.vmap(
-            lambda h, t, po: find_best_split(h, t, num_bin, na_bin, fmask,
-                                             params, po)
+            lambda h, t, po: select_fn(
+                find_best_split(h, t, num_bin, na_bin, fmask, params, po))
         )(hist2, totals2, parent_out2)
 
-    def grow_tree(binned, vals, feature_mask, num_bin, na_bin) -> TreeArrays:
-        n, f = binned.shape
+    def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
+                  na_bin_part=None) -> TreeArrays:
+        n, _f_global = binned.shape
+        binned_view = view_fn(binned)
+        f = binned_view.shape[1]
+        if na_bin_part is None:
+            na_bin_part = na_bin
 
-        hist0 = _hist(binned, vals)                       # [F, B, 3]
+        hist0 = _hist(binned_view, vals)                  # [F, B, 3]
         total0 = hist0[0].sum(axis=0)                     # [3] root aggregates
         root_out = leaf_output(total0[0], total0[1], params)
-        res0 = find_best_split(hist0, total0, num_bin, na_bin, feature_mask,
-                               params, root_out)
+        res0 = select_fn(find_best_split(hist0, total0, num_bin, na_bin,
+                                         feature_mask, params, root_out))
 
         neg_inf = jnp.float32(-jnp.inf)
         st = _GrowState(
@@ -168,7 +189,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
                 # --- partition rows (CUDADataPartition::Split analog) -----
                 fcol = jnp.take(binned, feat, axis=1).astype(jnp.int32)
-                nb = na_bin[feat]
+                nb = na_bin_part[feat]
                 is_na = (nb >= 0) & (fcol == nb)
                 go_left = jnp.where(is_na, dleft, fcol <= thr)
                 in_leaf = st.leaf_of_row == leaf
@@ -179,7 +200,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 smaller_left = lsum[2] <= rsum[2]
                 smaller_id = jnp.where(smaller_left, leaf, new_leaf)
                 mask = (leaf_of_row == smaller_id).astype(vals.dtype)[:, None]
-                hist_small = _hist(binned, vals * mask)
+                hist_small = _hist(binned_view, vals * mask)
                 hist_large = st.hist[leaf] - hist_small
                 hl_leaf = jnp.where(smaller_left, hist_small, hist_large)
                 hl_new = jnp.where(smaller_left, hist_large, hist_small)
@@ -250,4 +271,4 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             leaf_of_row=st.leaf_of_row,
         )
 
-    return jax.jit(grow_tree, donate_argnums=())
+    return jax.jit(grow_tree) if jit else grow_tree

@@ -1,0 +1,93 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime is C++ end-to-end; here the TPU compute path is
+JAX/XLA and the host-side hot paths that remain native are implemented in
+C++ and bound with ctypes (no pybind11 in the image): currently the text
+parser (parser.cpp — src/io/parser.cpp analog).  Binaries are built on
+first use with g++ and cached next to the sources.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libparser.so")
+_SRC = os.path.join(_DIR, "parser.cpp")
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    cmds = [
+        ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", _SRC, "-o", _SO],
+        ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],  # no-omp fallback
+    ]
+    for cmd in cmds:
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode == 0 and os.path.exists(_SO):
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not _build():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.lgbt_csv_shape.restype = ctypes.c_long
+            lib.lgbt_csv_shape.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+            lib.lgbt_csv_parse.restype = ctypes.c_long
+            lib.lgbt_csv_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_long, ctypes.c_long]
+            _lib = lib
+        except OSError:
+            _lib_failed = True
+    return _lib
+
+
+def native_parse_csv(path: str, delim: str = ",",
+                     has_header: bool = False) -> Optional[np.ndarray]:
+    """Parse a CSV/TSV file into [rows, cols] float64; None if the native
+    library is unavailable (caller falls back to NumPy)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.lgbt_csv_shape(path.encode(), delim.encode(),
+                            int(has_header), ctypes.byref(rows),
+                            ctypes.byref(cols))
+    if rc != 0 or rows.value <= 0 or cols.value <= 0:
+        return None
+    out = np.empty((rows.value, cols.value), np.float64)
+    rc = lib.lgbt_csv_parse(path.encode(), delim.encode(), int(has_header),
+                            out, rows.value, cols.value)
+    if rc != 0:
+        return None
+    return out

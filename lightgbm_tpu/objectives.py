@@ -244,12 +244,15 @@ class RegressionTweedie(ObjectiveFunction):
 class BinaryLogloss(ObjectiveFunction):
     name = "binary"
 
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = config.sigmoid
+
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         lbl = np.asarray(metadata.label)
         if not np.isin(np.unique(lbl), [0.0, 1.0]).all():
             raise ValueError("binary objective requires labels in {0, 1}")
-        self.sigmoid = self.config.sigmoid
         cnt_pos = float(lbl.sum()) if metadata.weight is None else \
             float((lbl * metadata.weight).sum())
         cnt_neg = (float(len(lbl) - lbl.sum()) if metadata.weight is None else

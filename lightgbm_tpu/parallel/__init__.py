@@ -1,0 +1,3 @@
+from .mesh import make_mesh, default_mesh
+from .data_parallel import make_dp_grower, shard_rows, pad_to_multiple
+from .feature_parallel import make_fp_grower

@@ -1,0 +1,59 @@
+"""Device-mesh construction for distributed training.
+
+Replaces the reference's whole communication stack
+(/root/reference/src/network/: hand-rolled Bruck allgather
+network.cpp:156, recursive-halving reduce-scatter :249, socket/MPI linkers)
+with ``jax.sharding.Mesh`` + XLA collectives over ICI/DCN — the schedule is
+owned by the compiler (SURVEY.md §2.5 TPU mapping).  Multi-host
+initialization goes through ``jax.distributed`` (the ``LGBM_NetworkInit``
+analog, c_api.h:1350) which wires the same collectives across hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("data",),
+              devices=None) -> Mesh:
+    """Build a mesh over the available devices.
+
+    shape=None uses all devices on one ``data`` axis (the GBDT scale axis —
+    rows; SURVEY.md §2.6: data-parallel is the reference's main distributed
+    mode, docs/Experiments.rst Criteo scaling).
+    """
+    devs = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devs),)
+    shape = tuple(int(s) for s in shape)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh needs {n} devices, have {len(devs)}")
+    mesh_devs = np.asarray(devs[:n]).reshape(shape)
+    if len(axis_names) != len(shape):
+        axis_names = tuple(f"axis{i}" for i in range(len(shape)))
+    return Mesh(mesh_devs, tuple(axis_names))
+
+
+def default_mesh(num: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    num = num or len(devs)
+    return make_mesh((num,), ("data",), devs)
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (jax.distributed) — the ``Network::Init`` /
+    ``LGBM_NetworkInit`` analog (network.cpp, c_api.h:1350).  On TPU pods
+    arguments are auto-detected from the runtime environment."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)

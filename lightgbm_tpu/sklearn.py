@@ -1,0 +1,283 @@
+"""scikit-learn compatible estimator API.
+
+Analog of the reference python-package sklearn layer
+(/root/reference/python-package/lightgbm/sklearn.py:343-1100):
+``LGBMModel`` base with ``LGBMRegressor`` / ``LGBMClassifier`` /
+``LGBMRanker``, objective/eval-function wrappers (:45-126), and the same
+constructor parameter surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .booster import Booster
+from .callback import early_stopping as early_stopping_cb
+from .callback import log_evaluation
+from .config import Config
+from .dataset import Dataset
+from .engine import train as train_fn
+
+
+class LGBMModel:
+    """Base estimator (sklearn.py:343 LGBMModel analog)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[str] = None, class_weight=None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state: Optional[int] = None, n_jobs: int = -1,
+                 importance_type: str = "split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._n_features = 0
+        self._classes = None
+        self._n_classes = 1
+        self.best_iteration_ = -1
+        self.best_score_: Dict = {}
+
+    # -- sklearn plumbing --------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = {k: getattr(self, k) for k in (
+            "boosting_type", "num_leaves", "max_depth", "learning_rate",
+            "n_estimators", "subsample_for_bin", "objective", "class_weight",
+            "min_split_gain", "min_child_weight", "min_child_samples",
+            "subsample", "subsample_freq", "colsample_bytree", "reg_alpha",
+            "reg_lambda", "random_state", "n_jobs", "importance_type")}
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for k, v in params.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self._other_params[k] = v
+        return self
+
+    def _lgb_params(self) -> Dict[str, Any]:
+        p = {
+            "boosting": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "objective": self.objective or self._default_objective(),
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbosity": 0,
+        }
+        if self.random_state is not None:
+            p["seed"] = int(self.random_state)
+        p.update(self._other_params)
+        return p
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    # -- fit/predict -------------------------------------------------------
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_group=None, eval_metric=None, feval=None,
+            early_stopping_rounds=None, callbacks=None,
+            categorical_feature="auto", feature_name="auto") -> "LGBMModel":
+        params = self._lgb_params()
+        y_t = self._process_label(np.asarray(y))
+        sample_weight = self._class_weights(sample_weight, y_t)
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        if early_stopping_rounds:
+            params["early_stopping_round"] = int(early_stopping_rounds)
+
+        ds = Dataset(X, label=y_t, weight=sample_weight, group=group,
+                     init_score=init_score, params=params,
+                     feature_name=feature_name,
+                     categorical_feature=categorical_feature)
+        valid_sets, valid_names = [], []
+        if eval_set:
+            for i, (vx, vy) in enumerate(eval_set):
+                vw = eval_sample_weight[i] if eval_sample_weight else None
+                vg = eval_group[i] if eval_group else None
+                valid_sets.append(Dataset(
+                    vx, label=self._process_label(np.asarray(vy)), weight=vw,
+                    group=vg, reference=ds))
+                valid_names.append(
+                    eval_names[i] if eval_names else f"valid_{i}")
+
+        self._Booster = train_fn(params, ds,
+                                 num_boost_round=self.n_estimators,
+                                 valid_sets=valid_sets or None,
+                                 valid_names=valid_names or None,
+                                 feval=feval, callbacks=callbacks)
+        self._n_features = np.asarray(X).shape[1] if hasattr(X, "shape") else \
+            len(X[0])
+        self.best_iteration_ = self._Booster.best_iteration
+        self.best_score_ = self._Booster.best_score
+        return self
+
+    def _process_label(self, y: np.ndarray) -> np.ndarray:
+        return y.astype(np.float32)
+
+    def _class_weights(self, sample_weight, y):
+        return sample_weight
+
+    def predict(self, X, raw_score: bool = False, num_iteration=None,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kw) -> np.ndarray:
+        self._check_fitted()
+        return self._Booster.predict(X, raw_score=raw_score,
+                                     num_iteration=num_iteration,
+                                     pred_leaf=pred_leaf,
+                                     pred_contrib=pred_contrib)
+
+    def _check_fitted(self):
+        if self._Booster is None:
+            raise ValueError("Estimator not fitted; call fit first")
+
+    # -- attributes --------------------------------------------------------
+    @property
+    def booster_(self) -> Booster:
+        self._check_fitted()
+        return self._Booster
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._Booster.feature_importance(self.importance_type)
+
+    @property
+    def n_features_(self) -> int:
+        return self._n_features
+
+    @property
+    def n_features_in_(self) -> int:
+        return self._n_features
+
+    @property
+    def n_estimators_(self) -> int:
+        self._check_fitted()
+        return self._Booster.current_iteration
+
+    @property
+    def feature_name_(self) -> List[str]:
+        self._check_fitted()
+        return self._Booster.feature_names
+
+
+class LGBMRegressor(LGBMModel):
+    """sklearn.py:919 LGBMRegressor analog."""
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel):
+    """sklearn.py:~990 LGBMClassifier analog."""
+
+    def _default_objective(self) -> str:
+        return "binary" if self._n_classes <= 2 else "multiclass"
+
+    def fit(self, X, y, **kw):
+        y = np.asarray(y)
+        self._classes, y_enc = np.unique(y, return_inverse=True)
+        self._n_classes = len(self._classes)
+        self._y_encoded = y_enc
+        params_extra = {}
+        if self._n_classes > 2:
+            params_extra["num_class"] = self._n_classes
+            self._other_params.setdefault("num_class", self._n_classes)
+        return super().fit(X, y_enc, **kw)
+
+    def _process_label(self, y):
+        return y.astype(np.float32)
+
+    def _class_weights(self, sample_weight, y):
+        if self.class_weight is None:
+            return sample_weight
+        if self.class_weight == "balanced":
+            counts = np.bincount(y.astype(int), minlength=self._n_classes)
+            w_per_class = len(y) / (self._n_classes * np.maximum(counts, 1))
+        else:
+            w_per_class = np.asarray([self.class_weight.get(c, 1.0)
+                                      for c in range(self._n_classes)])
+        w = w_per_class[y.astype(int)]
+        if sample_weight is not None:
+            w = w * np.asarray(sample_weight)
+        return w
+
+    @property
+    def classes_(self):
+        self._check_fitted()
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        return self._n_classes
+
+    def predict(self, X, raw_score=False, num_iteration=None,
+                pred_leaf=False, pred_contrib=False, **kw):
+        res = self.predict_proba(X, raw_score=raw_score,
+                                 num_iteration=num_iteration,
+                                 pred_leaf=pred_leaf,
+                                 pred_contrib=pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return res
+        if res.ndim > 1:
+            return self._classes[np.argmax(res, axis=1)]
+        return self._classes[(res > 0.5).astype(int)]
+
+    def predict_proba(self, X, raw_score=False, num_iteration=None,
+                      pred_leaf=False, pred_contrib=False, **kw):
+        self._check_fitted()
+        res = self._Booster.predict(X, raw_score=raw_score,
+                                    num_iteration=num_iteration,
+                                    pred_leaf=pred_leaf,
+                                    pred_contrib=pred_contrib)
+        if raw_score or pred_leaf or pred_contrib:
+            return res
+        if self._n_classes <= 2 and res.ndim == 1:
+            return np.column_stack([1.0 - res, res])
+        return res
+
+
+class LGBMRanker(LGBMModel):
+    """sklearn.py:~1060 LGBMRanker analog."""
+
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, group=None, **kw):
+        if group is None:
+            raise ValueError("LGBMRanker requires group")
+        return super().fit(X, y, group=group, **kw)

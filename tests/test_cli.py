@@ -1,0 +1,141 @@
+"""CLI / data-io / consistency tests.
+
+Mirrors the reference's CLI-vs-Python parity suite
+(tests/python_package_test/test_consistency.py) and the cpp CLI conf runs
+(tests/cpp_tests/test.py pattern): train via the config-file CLI, predict,
+and compare against the Python API on the same data.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import run as cli_run
+from lightgbm_tpu.data_io import detect_format, load_text
+from lightgbm_tpu.native import native_parse_csv
+
+
+@pytest.fixture()
+def csv_data(tmp_path):
+    rs = np.random.RandomState(0)
+    n = 1200
+    x = rs.randn(n, 5)
+    y = ((x[:, 0] + x[:, 1] > 0)).astype(np.float32)
+    data = np.column_stack([y, x])
+    path = str(tmp_path / "train.csv")
+    np.savetxt(path, data, delimiter=",", fmt="%.6f")
+    return path, x, y
+
+
+class TestDataIO:
+    def test_csv_roundtrip(self, csv_data):
+        path, x, y = csv_data
+        xl, yl = load_text(path)
+        np.testing.assert_allclose(xl, x, atol=1e-5)
+        np.testing.assert_allclose(yl, y, atol=1e-6)
+
+    def test_native_parser_matches_numpy(self, csv_data):
+        path, x, y = csv_data
+        arr = native_parse_csv(path, ",", False)
+        if arr is None:
+            pytest.skip("native parser unavailable")
+        ref = np.genfromtxt(path, delimiter=",")
+        np.testing.assert_allclose(arr, ref, atol=1e-12)
+
+    def test_native_parser_missing_values(self, tmp_path):
+        p = str(tmp_path / "m.csv")
+        with open(p, "w") as f:
+            f.write("1.5,,3\n,2.5,na\n")
+        arr = native_parse_csv(p, ",", False)
+        if arr is None:
+            pytest.skip("native parser unavailable")
+        assert arr.shape == (2, 3)
+        assert arr[0, 0] == 1.5 and np.isnan(arr[0, 1]) and arr[0, 2] == 3
+        assert np.isnan(arr[1, 0]) and arr[1, 1] == 2.5 and np.isnan(arr[1, 2])
+
+    def test_tsv_detect(self, tmp_path):
+        p = str(tmp_path / "d.tsv")
+        with open(p, "w") as f:
+            f.write("1\t2\t3\n4\t5\t6\n")
+        assert detect_format(p) == "tsv"
+
+    def test_libsvm(self, tmp_path):
+        p = str(tmp_path / "d.svm")
+        with open(p, "w") as f:
+            f.write("1 0:1.5 3:2.0\n0 1:0.5\n")
+        x, y = load_text(p)
+        assert x.shape == (2, 4)
+        assert x[0, 0] == 1.5 and x[0, 3] == 2.0 and x[1, 1] == 0.5
+        np.testing.assert_array_equal(y, [1, 0])
+
+
+class TestCLI:
+    def test_train_predict_consistency(self, csv_data, tmp_path):
+        path, x, y = csv_data
+        conf = str(tmp_path / "train.conf")
+        model_path = str(tmp_path / "model.txt")
+        with open(conf, "w") as f:
+            f.write(f"""
+task = train
+objective = binary
+data = {path}
+num_trees = 10
+num_leaves = 7
+max_bin = 31
+min_data_in_leaf = 5
+output_model = {model_path}
+verbosity = 0
+""")
+        assert cli_run([f"config={conf}"]) == 0
+        assert os.path.exists(model_path)
+
+        # predict task
+        out_path = str(tmp_path / "preds.txt")
+        assert cli_run([
+            "task=predict", f"data={path}", f"input_model={model_path}",
+            f"output_result={out_path}"]) == 0
+        cli_preds = np.loadtxt(out_path)
+
+        # python API on same data must match (consistency suite pattern)
+        bst = lgb.Booster(model_file=model_path)
+        py_preds = bst.predict(x)
+        np.testing.assert_allclose(cli_preds, py_preds, rtol=1e-5, atol=1e-6)
+        # and the model must actually be good
+        acc = ((py_preds > 0.5) == y).mean()
+        assert acc > 0.9
+
+    def test_cli_overrides_config_file(self, csv_data, tmp_path):
+        path, _, _ = csv_data
+        conf = str(tmp_path / "c.conf")
+        model_path = str(tmp_path / "m.txt")
+        with open(conf, "w") as f:
+            f.write(f"task = train\nobjective = binary\ndata = {path}\n"
+                    f"num_trees = 3\noutput_model = {model_path}\n"
+                    f"max_bin = 31\nverbosity = 0\n")
+        assert cli_run([f"config={conf}", "num_trees=5"]) == 0
+        bst = lgb.Booster(model_file=model_path)
+        assert bst.num_trees() == 5
+
+    def test_refit_task(self, csv_data, tmp_path):
+        path, x, y = csv_data
+        model_path = str(tmp_path / "m.txt")
+        refit_path = str(tmp_path / "m2.txt")
+        cli_run(["task=train", "objective=binary", f"data={path}",
+                 "num_trees=5", "num_leaves=7", "max_bin=31",
+                 f"output_model={model_path}", "verbosity=0"])
+        assert cli_run(["task=refit", f"data={path}",
+                        f"input_model={model_path}",
+                        f"output_model={refit_path}"]) == 0
+        bst = lgb.Booster(model_file=refit_path)
+        p = bst.predict(x)
+        assert ((p > 0.5) == y).mean() > 0.85
+
+    def test_save_binary_task(self, csv_data, tmp_path):
+        path, _, _ = csv_data
+        assert cli_run(["task=save_binary", f"data={path}", "max_bin=31"]) == 0
+        ds = lgb.Dataset.load_binary(path + ".bin.npz")
+        assert ds.num_data == 1200

@@ -1,0 +1,82 @@
+"""Ranking objectives/metrics + SHAP contribution tests
+(test_engine.py ranking & contrib sections analog, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _ranking_data(n_q=50, q_size=16, seed=0):
+    rs = np.random.RandomState(seed)
+    n = n_q * q_size
+    x = rs.randn(n, 6)
+    rel = 1.5 * x[:, 0] + x[:, 1] + 0.3 * rs.randn(n)
+    y = np.zeros(n, np.int32)
+    for q in range(n_q):
+        s = slice(q * q_size, (q + 1) * q_size)
+        ranks = np.argsort(np.argsort(-rel[s]))
+        y[s] = np.clip(3 - ranks // 4, 0, 3)
+    return x, y, [q_size] * n_q
+
+
+class TestRanking:
+    @pytest.mark.parametrize("obj", ["lambdarank", "rank_xendcg"])
+    def test_ndcg_improves(self, obj):
+        x, y, group = _ranking_data()
+        p = {"objective": obj, "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "metric": ["ndcg"], "eval_at": [5]}
+        ds = lgb.Dataset(x, label=y, group=group)
+        vx, vy, vg = _ranking_data(seed=1)
+        vds = lgb.Dataset(vx, label=vy, group=vg, reference=ds)
+        rec = {}
+        bst = lgb.train(p, ds, num_boost_round=30, valid_sets=[vds],
+                        callbacks=[lgb.record_evaluation(rec)])
+        ndcg = rec["valid_0"]["ndcg@5"]
+        assert ndcg[-1] > ndcg[0]
+        assert ndcg[-1] > 0.80, f"ndcg@5 {ndcg[-1]}"
+
+    def test_ndcg_metric_perfect_and_random(self):
+        from lightgbm_tpu.metrics import NDCGMetric
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.dataset import Metadata
+        cfg = Config({"objective": "lambdarank", "eval_at": [3]})
+        md = Metadata(8)
+        md.set_label(np.array([3, 2, 1, 0, 3, 2, 1, 0], np.float32))
+        md.set_group([4, 4])
+        m = NDCGMetric(cfg)
+        m.init(md, 8)
+        perfect = m.eval(np.array([4., 3, 2, 1, 4, 3, 2, 1]))
+        assert perfect[0][1] == pytest.approx(1.0)
+        worst = m.eval(np.array([1., 2, 3, 4, 1, 2, 3, 4]))
+        assert worst[0][1] < 1.0
+
+
+class TestSHAP:
+    def test_contrib_sums_to_prediction(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "min_data_in_leaf": 20}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=5)
+        xs = x[:20]
+        contrib = bst.predict(xs, pred_contrib=True)
+        assert contrib.shape == (20, x.shape[1] + 1)
+        raw = bst.predict(xs, raw_score=True)
+        np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_contrib_regression(self, regression_data):
+        x, y = regression_data
+        p = {"objective": "regression", "num_leaves": 7, "max_bin": 31}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=3)
+        xs = x[:10]
+        contrib = bst.predict(xs, pred_contrib=True)
+        raw = bst.predict(xs, raw_score=True)
+        np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4,
+                                   atol=1e-4)
+        # uninformative feature contributes ~nothing
+        # (feature with no splits has zero attribution)
+        imp = bst.feature_importance("split")
+        for f in range(x.shape[1]):
+            if imp[f] == 0:
+                np.testing.assert_allclose(contrib[:, f], 0.0, atol=1e-9)

@@ -1,0 +1,106 @@
+"""sklearn-API tests (test_sklearn.py analog, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.sklearn import LGBMClassifier, LGBMRanker, LGBMRegressor
+from lightgbm_tpu.metrics import _auc
+
+
+class TestRegressor:
+    def test_fit_predict(self, regression_data):
+        x, y = regression_data
+        m = LGBMRegressor(n_estimators=30, num_leaves=15, max_bin=63,
+                          random_state=0)
+        m.fit(x[:3000], y[:3000])
+        pred = m.predict(x[3000:])
+        mse = np.mean((pred - y[3000:]) ** 2)
+        assert mse < 0.5 * np.var(y[3000:])
+        assert m.n_features_in_ == x.shape[1]
+        assert len(m.feature_importances_) == x.shape[1]
+        assert m.feature_importances_.sum() > 0
+
+    def test_get_set_params(self):
+        m = LGBMRegressor(num_leaves=7)
+        p = m.get_params()
+        assert p["num_leaves"] == 7
+        m.set_params(num_leaves=63, learning_rate=0.3)
+        assert m.num_leaves == 63
+        assert m.learning_rate == 0.3
+
+    def test_regularization_params(self, regression_data):
+        x, y = regression_data
+        m = LGBMRegressor(n_estimators=5, num_leaves=15, reg_alpha=1.0,
+                          reg_lambda=5.0, max_bin=31)
+        m.fit(x[:1000], y[:1000])
+        assert np.isfinite(m.predict(x[:50])).all()
+
+
+class TestClassifier:
+    def test_binary(self, binary_data):
+        x, y = binary_data
+        m = LGBMClassifier(n_estimators=20, num_leaves=15, max_bin=63)
+        m.fit(x[:3000], y[:3000])
+        assert set(m.classes_) == {0.0, 1.0}
+        proba = m.predict_proba(x[3000:])
+        assert proba.shape == (1000, 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+        pred = m.predict(x[3000:])
+        assert (pred == y[3000:]).mean() > 0.88
+
+    def test_multiclass_string_labels(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1500, 6)
+        y_num = (x[:, 0] > 0.5).astype(int) + (x[:, 1] > 0).astype(int)
+        y = np.array(["a", "b", "c"])[y_num]
+        m = LGBMClassifier(n_estimators=10, num_leaves=15, max_bin=31)
+        m.fit(x, y)
+        assert list(m.classes_) == ["a", "b", "c"]
+        pred = m.predict(x[:100])
+        assert set(pred) <= {"a", "b", "c"}
+        assert (pred == y[:100]).mean() > 0.7
+
+    def test_class_weight_balanced(self, binary_data):
+        x, y = binary_data
+        m = LGBMClassifier(n_estimators=10, num_leaves=7, max_bin=31,
+                           class_weight="balanced")
+        m.fit(x, y)
+        assert np.isfinite(m.predict_proba(x[:10])).all()
+
+    def test_eval_set_early_stopping(self, binary_data):
+        x, y = binary_data
+        m = LGBMClassifier(n_estimators=200, num_leaves=31, max_bin=63,
+                           metric="auc")
+        rs = np.random.RandomState(7)
+        m.fit(x[:3000], y[:3000],
+              eval_set=[(x[3000:], rs.permutation(y[3000:]))],
+              early_stopping_rounds=3)
+        assert m.best_iteration_ > 0
+        assert m.n_estimators_ < 200
+
+
+class TestRanker:
+    def test_lambdarank(self):
+        rs = np.random.RandomState(0)
+        n_q, q_size = 60, 20
+        n = n_q * q_size
+        x = rs.randn(n, 8)
+        rel = 2.0 * x[:, 0] + x[:, 1] + 0.3 * rs.randn(n)
+        # graded relevance 0..4 per query
+        y = np.zeros(n, np.int32)
+        for q in range(n_q):
+            s = slice(q * q_size, (q + 1) * q_size)
+            ranks = np.argsort(np.argsort(-rel[s]))
+            y[s] = np.clip(4 - ranks // 4, 0, 4)
+        group = [q_size] * n_q
+        m = LGBMRanker(n_estimators=20, num_leaves=15, max_bin=63,
+                       min_child_samples=5)
+        m.fit(x, y, group=group)
+        pred = m.predict(x)
+        # within-query ordering should correlate with relevance
+        corr = np.corrcoef(pred, rel)[0, 1]
+        assert corr > 0.5, f"rank correlation too low: {corr}"
+
+    def test_requires_group(self):
+        with pytest.raises(ValueError):
+            LGBMRanker().fit(np.zeros((10, 2)), np.zeros(10))
