@@ -162,6 +162,7 @@ _PARAMS: Dict[str, tuple] = {
     "mesh_shape": (list, None, []),          # e.g. [8] or [4, 2]
     "mesh_axis_names": (list, None, []),     # e.g. ["data"] or ["data", "feature"]
     "hist_dtype": (str, "float32", []),      # histogram accumulation dtype
+    "tpu_learner": (str, "partitioned", []),  # partitioned | masked
     "rows_per_block": (int, 0, []),          # 0 = auto-tune histogram row blocking
     "use_pallas": (bool, True, []),          # use Pallas kernels where available
     # ---- IO / task ----

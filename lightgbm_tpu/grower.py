@@ -54,6 +54,8 @@ class TreeArrays(NamedTuple):
     internal_count: jax.Array    # [L-1] f32
     leaf_depth: jax.Array        # [L] int32
     leaf_of_row: jax.Array       # [N] int32 — final row -> leaf assignment
+    is_cat_node: jax.Array       # [L-1] bool — categorical split flags
+    cat_rank: jax.Array          # [L-1, B] int32 — per-node bin decision rank
 
 
 class _GrowState(NamedTuple):
@@ -68,6 +70,8 @@ class _GrowState(NamedTuple):
     brs: jax.Array               # [L, 3] right sums
     blo: jax.Array               # [L] left output
     bro: jax.Array               # [L] right output
+    bic: jax.Array               # [L] bool is-categorical
+    brank: jax.Array             # [L, B] decision rank vector
     # tree arrays under construction
     split_feature: jax.Array
     threshold_bin: jax.Array
@@ -85,6 +89,8 @@ class _GrowState(NamedTuple):
     leaf_parent: jax.Array       # [L] int32
     num_leaves: jax.Array        # scalar int32
     done: jax.Array              # scalar bool
+    is_cat_node: jax.Array       # [L-1] bool
+    cat_rank: jax.Array          # [L-1, B] int32
 
 
 def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
@@ -119,14 +125,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                               block_rows=block_rows)
         return reduce_fn(h)
 
-    def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2):
+    def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2, is_cat):
         return jax.vmap(
             lambda h, t, po: select_fn(
-                find_best_split(h, t, num_bin, na_bin, fmask, params, po))
+                find_best_split(h, t, num_bin, na_bin, fmask, params, po,
+                                is_cat))
         )(hist2, totals2, parent_out2)
 
     def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
-                  na_bin_part=None) -> TreeArrays:
+                  na_bin_part=None, is_cat=None) -> TreeArrays:
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
         f = binned_view.shape[1]
@@ -137,7 +144,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         total0 = hist0[0].sum(axis=0)                     # [3] root aggregates
         root_out = leaf_output(total0[0], total0[1], params)
         res0 = select_fn(find_best_split(hist0, total0, num_bin, na_bin,
-                                         feature_mask, params, root_out))
+                                         feature_mask, params, root_out,
+                                         is_cat))
 
         neg_inf = jnp.float32(-jnp.inf)
         st = _GrowState(
@@ -151,6 +159,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             brs=jnp.zeros((L, 3)).at[0].set(res0.right_sum),
             blo=jnp.zeros(L).at[0].set(res0.left_output),
             bro=jnp.zeros(L).at[0].set(res0.right_output),
+            bic=jnp.zeros(L, bool).at[0].set(res0.is_cat),
+            brank=jnp.zeros((L, B), jnp.int32).at[0].set(res0.bin_rank),
             split_feature=jnp.zeros(L - 1, jnp.int32),
             threshold_bin=jnp.zeros(L - 1, jnp.int32),
             default_left=jnp.zeros(L - 1, bool),
@@ -167,6 +177,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             leaf_parent=jnp.full(L, -1, jnp.int32),
             num_leaves=jnp.int32(1),
             done=jnp.bool_(False),
+            is_cat_node=jnp.zeros(L - 1, bool),
+            cat_rank=jnp.broadcast_to(
+                jnp.arange(B, dtype=jnp.int32)[None], (L - 1, B)) + 0,
         )
 
         def split_step(i, st: _GrowState) -> _GrowState:
@@ -178,6 +191,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 feat, thr = st.bf[leaf], st.bt[leaf]
                 dleft = st.bdl[leaf]
                 lsum, rsum = st.bls[leaf], st.brs[leaf]
+                icat, rank_vec = st.bic[leaf], st.brank[leaf]
 
                 # --- tree bookkeeping (Tree::Split, src/io/tree.cpp) ------
                 parent = st.leaf_parent[leaf]
@@ -188,10 +202,12 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 rc = jnp.where(fix_r, i, st.right_child).at[i].set(~new_leaf)
 
                 # --- partition rows (CUDADataPartition::Split analog) -----
+                # decision rank unifies numerical (iota rank) and
+                # categorical (ratio-order rank) predicates
                 fcol = jnp.take(binned, feat, axis=1).astype(jnp.int32)
                 nb = na_bin_part[feat]
-                is_na = (nb >= 0) & (fcol == nb)
-                go_left = jnp.where(is_na, dleft, fcol <= thr)
+                is_na = (nb >= 0) & (fcol == nb) & (~icat)
+                go_left = jnp.where(is_na, dleft, rank_vec[fcol] <= thr)
                 in_leaf = st.leaf_of_row == leaf
                 leaf_of_row = jnp.where(in_leaf & (~go_left), new_leaf,
                                         st.leaf_of_row)
@@ -218,7 +234,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 hist2 = jnp.stack([hl_leaf, hl_new])
                 tot2 = jnp.stack([lsum, rsum])
                 po2 = jnp.stack([st.blo[leaf], st.bro[leaf]])
-                r2 = _best2(hist2, tot2, num_bin, na_bin, feature_mask, po2)
+                r2 = _best2(hist2, tot2, num_bin, na_bin, feature_mask, po2,
+                            is_cat)
                 depth_ok = (max_depth <= 0) | (d < max_depth)
                 g2 = jnp.where(depth_ok, r2.gain, -jnp.inf)
 
@@ -233,6 +250,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     brs=st.brs.at[leaf].set(r2.right_sum[0]).at[new_leaf].set(r2.right_sum[1]),
                     blo=st.blo.at[leaf].set(r2.left_output[0]).at[new_leaf].set(r2.left_output[1]),
                     bro=st.bro.at[leaf].set(r2.right_output[0]).at[new_leaf].set(r2.right_output[1]),
+                    bic=st.bic.at[leaf].set(r2.is_cat[0]).at[new_leaf].set(r2.is_cat[1]),
+                    brank=st.brank.at[leaf].set(r2.bin_rank[0]).at[new_leaf].set(r2.bin_rank[1]),
                     split_feature=st.split_feature.at[i].set(feat),
                     threshold_bin=st.threshold_bin.at[i].set(thr),
                     default_left=st.default_left.at[i].set(dleft),
@@ -247,6 +266,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     leaf_parent=st.leaf_parent.at[leaf].set(i).at[new_leaf].set(i),
                     num_leaves=new_leaf + 1,
                     done=st.done,
+                    is_cat_node=st.is_cat_node.at[i].set(icat),
+                    cat_rank=st.cat_rank.at[i].set(rank_vec),
                 )
 
             return lax.cond(can_split, do_split,
@@ -269,6 +290,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             internal_count=st.internal_count,
             leaf_depth=st.leaf_depth,
             leaf_of_row=st.leaf_of_row,
+            is_cat_node=st.is_cat_node,
+            cat_rank=st.cat_rank,
         )
 
     return jax.jit(grow_tree) if jit else grow_tree

@@ -1,0 +1,349 @@
+"""Partitioned leaf-wise grower: the single-chip performance learner.
+
+Where grower.py's fully-jitted program pays a full-N masked histogram pass
+per split, this learner keeps the reference's work complexity — histogram
+work proportional to the SMALLER child (serial_tree_learner.cpp:283-323
+smaller/larger leaf logic + subtraction trick), via:
+
+- a device-resident row-permutation ``order`` grouped by leaf — the
+  ``DataPartition::indices_`` analog (data_partition.hpp:161), repartitioned
+  in place per split with an O(P) cumsum scatter (the CUDA learner's
+  prefix-sum pipeline, cuda_data_partition.cu:288);
+- host-orchestrated per-split loop (one tiny D2H of the two child split
+  records per split — the same sync the CUDA learner does,
+  cuda_single_gpu_tree_learner.cpp:118-228) with power-of-2 size bucketing
+  so every jitted kernel has a static shape (~log2(N) compile variants);
+- gathered-row histogram construction on the MXU (ops/histogram.py).
+
+Output matches grower.py's TreeArrays bit-for-bit in structure; tests
+assert equivalence between the two learners.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .grower import TreeArrays
+from .ops.histogram import compute_histogram
+from .ops.split import SplitParams, SplitResult, find_best_split, leaf_output
+
+
+def _pow2(x: int) -> int:
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
+@functools.partial(jax.jit, static_argnames=("p", "num_bins", "block_rows"))
+def _hist_segment(order, binned, vals, begin, count, *, p, num_bins,
+                  block_rows=0):
+    """Histogram over rows order[begin:begin+count], padded to p."""
+    n = order.shape[0]
+    pos = begin + jnp.arange(p, dtype=jnp.int32)
+    idx = order[jnp.clip(pos, 0, n - 1)]
+    rows = jnp.take(binned, idx, axis=0)
+    mask = (jnp.arange(p) < count).astype(vals.dtype)
+    v = jnp.take(vals, idx, axis=0) * mask[:, None]
+    return compute_histogram(rows, v, num_bins=num_bins,
+                             block_rows=block_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _partition_segment(order, binned, na_bin, feat, thr, dleft, icat,
+                       rank_vec, begin, count, *, p):
+    """Stable in-place partition of order[begin:begin+count] by the split
+    predicate (left block first).  Returns (order, left_count).
+    ``rank_vec`` [B] is the decision rank (iota for numerical splits)."""
+    n = order.shape[0]
+    pos = begin + jnp.arange(p, dtype=jnp.int32)
+    cpos = jnp.clip(pos, 0, n - 1)
+    idx = order[cpos]
+    fcol = binned[idx, feat].astype(jnp.int32)
+    nb = na_bin[feat]
+    is_na = (nb >= 0) & (fcol == nb) & (~icat)
+    valid = jnp.arange(p) < count
+    go_left = jnp.where(is_na, dleft, rank_vec[fcol] <= thr) & valid
+    go_right = (~go_left) & valid
+    cl = go_left.sum()
+    # O(p) stable partition via cumsum ranks (no sort)
+    left_rank = jnp.cumsum(go_left) - 1
+    right_rank = cl + jnp.cumsum(go_right) - 1
+    inv_rank = count + jnp.cumsum(~valid) - 1
+    dest = jnp.where(go_left, left_rank,
+                     jnp.where(go_right, right_rank, inv_rank))
+    dest_pos = begin + dest.astype(jnp.int32)
+    dest_pos = jnp.where(pos < n, dest_pos, n)  # out-of-range -> dropped
+    new_order = order.at[dest_pos].set(idx, mode="drop")
+    return new_order, cl
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def _leaf_of_row(order, seg_begins, seg_leafs, *, num_leaves):
+    """Reconstruct row->leaf from the order permutation + host segment map."""
+    n = order.shape[0]
+    seg = jnp.searchsorted(seg_begins, jnp.arange(n, dtype=jnp.int32),
+                           side="right") - 1
+    leaf_by_pos = seg_leafs[seg]
+    return jnp.zeros(n, jnp.int32).at[order].set(leaf_by_pos)
+
+
+class _HostSplit(NamedTuple):
+    gain: float
+    feature: int
+    threshold: int
+    default_left: bool
+    left_sum: np.ndarray
+    right_sum: np.ndarray
+    left_output: float
+    right_output: float
+    is_cat: bool
+    bin_rank: np.ndarray
+
+
+def _pull(res: SplitResult) -> _HostSplit:
+    return _HostSplit(
+        gain=float(res.gain), feature=int(res.feature),
+        threshold=int(res.threshold), default_left=bool(res.default_left),
+        left_sum=np.asarray(res.left_sum), right_sum=np.asarray(res.right_sum),
+        left_output=float(res.left_output), right_output=float(res.right_output),
+        is_cat=bool(res.is_cat), bin_rank=np.asarray(res.bin_rank))
+
+
+class PartitionedGrower:
+    """Host-orchestrated device-resident leaf-wise learner.
+
+    Optional per-node controls (host bookkeeping, device search):
+    - ``mono``: [F] -1/0/+1 monotone constraints ('basic' range method,
+      monotone_constraints.hpp BasicLeafConstraints analog);
+    - ``interaction_allow``: [F, F] bool — after splitting on f, children may
+      only use features with interaction_allow[f] (ColSampler interaction
+      constraints, col_sampler.hpp:20-91);
+    - ``bynode_frac`` < 1: feature_fraction_bynode re-sampling per node.
+    """
+
+    def __init__(self, *, num_leaves: int, num_bins: int, params: SplitParams,
+                 max_depth: int = -1, block_rows: int = 0,
+                 mono: Optional[np.ndarray] = None,
+                 interaction_allow: Optional[np.ndarray] = None,
+                 bynode_frac: float = 1.0, bynode_seed: int = 0):
+        self.L = int(num_leaves)
+        self.B = int(num_bins)
+        self.params = params
+        self.max_depth = max_depth
+        self.block_rows = block_rows
+        self.mono = None if mono is None or not np.any(mono) else \
+            jnp.asarray(mono, jnp.int32)
+        self.interaction_allow = interaction_allow
+        self.bynode_frac = bynode_frac
+        self._bynode_rng = np.random.RandomState(bynode_seed)
+        self._find = jax.jit(functools.partial(find_best_split, params=params))
+
+    def grow(self, binned, vals, feature_mask, num_bin, na_bin,
+             is_cat=None) -> TreeArrays:
+        L, B = self.L, self.B
+        n, f = binned.shape
+        p_full = _pow2(n)
+        order = jnp.arange(n, dtype=jnp.int32)
+
+        # root histogram + split
+        hist0 = _hist_segment(order, binned, vals, jnp.int32(0), jnp.int32(n),
+                              p=p_full, num_bins=B, block_rows=self.block_rows)
+        total0 = np.asarray(hist0[0].sum(axis=0))
+        root_out = float(leaf_output(jnp.float32(total0[0]),
+                                     jnp.float32(total0[1]), self.params))
+        base_mask = np.asarray(feature_mask, bool)
+        leaf_mask = {0: base_mask}
+        inf = np.float32(np.finfo(np.float32).max)
+        leaf_lo = {0: -inf}
+        leaf_hi = {0: inf}
+
+        def _node_mask(mask: np.ndarray) -> jax.Array:
+            if self.bynode_frac < 1.0:
+                f_all = len(mask)
+                k = max(1, int(round(mask.sum() * self.bynode_frac)))
+                on = np.nonzero(mask)[0]
+                keep = self._bynode_rng.choice(on, size=min(k, len(on)),
+                                               replace=False)
+                m = np.zeros(f_all, bool)
+                m[keep] = True
+                return jnp.asarray(m)
+            return jnp.asarray(mask)
+
+        def _find_leaf(hist, total, pout, leaf):
+            kw = {}
+            if self.mono is not None:
+                kw = dict(mono=self.mono,
+                          out_lo=jnp.float32(leaf_lo[leaf]),
+                          out_hi=jnp.float32(leaf_hi[leaf]))
+            return self._find(hist, jnp.asarray(total, jnp.float32),
+                              num_bin, na_bin, _node_mask(leaf_mask[leaf]),
+                              parent_output=jnp.float32(pout),
+                              is_cat=is_cat, **kw)
+
+        hists = {0: hist0}
+        cand = {0: _pull(_find_leaf(hist0, total0, root_out, 0))}
+        totals = {0: total0}
+        parent_out = {0: root_out}
+
+        # host tree state
+        begins = {0: 0}
+        counts = {0: n}
+        depth = {0: 0}
+        leaf_parent = {0: -1}
+        split_feature = np.zeros(L - 1, np.int32)
+        threshold_bin = np.zeros(L - 1, np.int32)
+        default_left = np.zeros(L - 1, bool)
+        left_child = np.zeros(L - 1, np.int32)
+        right_child = np.zeros(L - 1, np.int32)
+        split_gain = np.zeros(L - 1, np.float32)
+        leaf_value = np.zeros(L, np.float32)
+        leaf_weight = np.zeros(L, np.float32)
+        leaf_count = np.zeros(L, np.float32)
+        internal_value = np.zeros(L - 1, np.float32)
+        internal_weight = np.zeros(L - 1, np.float32)
+        internal_count = np.zeros(L - 1, np.float32)
+        leaf_depth_arr = np.zeros(L, np.int32)
+        is_cat_node = np.zeros(L - 1, bool)
+        cat_rank = np.broadcast_to(np.arange(B, dtype=np.int32)[None],
+                                   (L - 1, B)).copy()
+        leaf_value[0] = root_out
+        leaf_weight[0] = total0[1]
+        leaf_count[0] = total0[2]
+
+        num_leaves = 1
+        for i in range(L - 1):
+            # pick best leaf (host argmax — the per-leaf candidates are here)
+            ok = [l for l in range(num_leaves)
+                  if cand[l].gain > 0
+                  and (self.max_depth <= 0 or depth[l] < self.max_depth)]
+            if not ok:
+                break
+            leaf = max(ok, key=lambda l: cand[l].gain)
+            rec = cand[leaf]
+            new = num_leaves
+
+            # tree bookkeeping (Tree::Split)
+            parent = leaf_parent[leaf]
+            if parent >= 0:
+                if left_child[parent] == ~leaf:
+                    left_child[parent] = i
+                else:
+                    right_child[parent] = i
+            left_child[i] = ~leaf
+            right_child[i] = ~new
+            split_feature[i] = rec.feature
+            threshold_bin[i] = rec.threshold
+            default_left[i] = rec.default_left
+            split_gain[i] = rec.gain
+            internal_value[i] = leaf_value[leaf]
+            internal_weight[i] = leaf_weight[leaf]
+            internal_count[i] = leaf_count[leaf]
+            leaf_parent[leaf] = i
+            leaf_parent[new] = i
+            is_cat_node[i] = rec.is_cat
+            cat_rank[i] = rec.bin_rank
+
+            # partition the leaf's segment
+            begin, cnt = begins[leaf], counts[leaf]
+            p_seg = min(_pow2(max(cnt, 1)), p_full)
+            order, cl_dev = _partition_segment(
+                order, binned, na_bin, jnp.int32(rec.feature),
+                jnp.int32(rec.threshold), jnp.bool_(rec.default_left),
+                jnp.bool_(rec.is_cat), jnp.asarray(rec.bin_rank),
+                jnp.int32(begin), jnp.int32(cnt), p=p_seg)
+            # actual moved-row count (with bagging, out-of-bag rows follow
+            # the split too, so segment size != in-bag left_sum count)
+            cl = int(cl_dev)
+            cr = cnt - cl
+            begins[leaf], counts[leaf] = begin, cl
+            begins[new], counts[new] = begin + cl, cr
+            d = depth[leaf] + 1
+            depth[leaf] = d
+            depth[new] = d
+            leaf_value[leaf] = rec.left_output
+            leaf_value[new] = rec.right_output
+            leaf_weight[leaf] = rec.left_sum[1]
+            leaf_weight[new] = rec.right_sum[1]
+            leaf_count[leaf] = rec.left_sum[2]
+            leaf_count[new] = rec.right_sum[2]
+            leaf_depth_arr[leaf] = d
+            leaf_depth_arr[new] = d
+
+            # histogram: smaller child constructed, larger by subtraction
+            sm, lg = (leaf, new) if cl <= cr else (new, leaf)
+            p_sm = min(_pow2(max(counts[sm], 1)), p_full)
+            hist_sm = _hist_segment(order, binned, vals,
+                                    jnp.int32(begins[sm]),
+                                    jnp.int32(counts[sm]), p=p_sm,
+                                    num_bins=B, block_rows=self.block_rows)
+            hist_lg = hists[leaf] - hist_sm
+            hists[sm], hists[lg] = hist_sm, hist_lg
+            totals[leaf] = rec.left_sum
+            totals[new] = rec.right_sum
+            parent_out[leaf] = rec.left_output
+            parent_out[new] = rec.right_output
+
+            # constraint propagation to children
+            if self.interaction_allow is not None:
+                child_mask = leaf_mask[leaf] & self.interaction_allow[rec.feature]
+            else:
+                child_mask = leaf_mask[leaf]
+            leaf_mask[leaf] = child_mask
+            leaf_mask[new] = child_mask
+            lo_p, hi_p = leaf_lo[leaf], leaf_hi[leaf]
+            mc = 0 if self.mono is None else int(np.asarray(self.mono)[rec.feature])
+            if mc != 0 and not rec.is_cat:
+                mid = 0.5 * (rec.left_output + rec.right_output)
+                if mc > 0:   # left (smaller values) must output <= right
+                    leaf_lo[leaf], leaf_hi[leaf] = lo_p, min(hi_p, mid)
+                    leaf_lo[new], leaf_hi[new] = max(lo_p, mid), hi_p
+                else:
+                    leaf_lo[leaf], leaf_hi[leaf] = max(lo_p, mid), hi_p
+                    leaf_lo[new], leaf_hi[new] = lo_p, min(hi_p, mid)
+            else:
+                leaf_lo[new], leaf_hi[new] = lo_p, hi_p
+
+            # new candidates for both children (async until pulled)
+            r_l = _find_leaf(hists[leaf], totals[leaf], parent_out[leaf], leaf)
+            r_r = _find_leaf(hists[new], totals[new], parent_out[new], new)
+            cand[leaf] = _pull(r_l)
+            cand[new] = _pull(r_r)
+            num_leaves = new + 1
+            del cl_dev
+
+        # reconstruct leaf_of_row from segments
+        seg = sorted(((begins[l], l) for l in range(num_leaves)))
+        seg_begins = jnp.asarray([s[0] for s in seg], jnp.int32)
+        seg_leafs = jnp.asarray([s[1] for s in seg], jnp.int32)
+        lor = _leaf_of_row(order, seg_begins, seg_leafs, num_leaves=L)
+
+        return TreeArrays(
+            num_leaves=jnp.int32(num_leaves),
+            split_feature=jnp.asarray(split_feature),
+            threshold_bin=jnp.asarray(threshold_bin),
+            default_left=jnp.asarray(default_left),
+            left_child=jnp.asarray(left_child),
+            right_child=jnp.asarray(right_child),
+            split_gain=jnp.asarray(split_gain),
+            leaf_value=jnp.asarray(leaf_value),
+            leaf_weight=jnp.asarray(leaf_weight),
+            leaf_count=jnp.asarray(leaf_count),
+            internal_value=jnp.asarray(internal_value),
+            internal_weight=jnp.asarray(internal_weight),
+            internal_count=jnp.asarray(internal_count),
+            leaf_depth=jnp.asarray(leaf_depth_arr),
+            leaf_of_row=lor,
+            is_cat_node=jnp.asarray(is_cat_node),
+            cat_rank=jnp.asarray(cat_rank),
+        )
+
+    def __call__(self, binned, vals, feature_mask, num_bin, na_bin,
+                 is_cat=None):
+        return self.grow(binned, vals, feature_mask, num_bin, na_bin, is_cat)

@@ -45,13 +45,11 @@ class DARTModel(GBDTModel):
         return sorted(drop)
 
     def _tree_contrib(self, binned, ti: int, k: int):
+        from .gbdt import _apply_tree
         dt = self.device_trees[ti * self.num_class + k]
         w = self.tree_weights[ti * self.num_class + k]
         zero = jnp.zeros(binned.shape[0], jnp.float32)
-        return add_tree_score(zero, binned, dt.split_feature, dt.threshold_bin,
-                              dt.default_left, dt.left_child, dt.right_child,
-                              self.na_bin_dev, dt.leaf_value, jnp.float32(w),
-                              steps=dt.steps)
+        return _apply_tree(zero, binned, dt, self.na_bin_dev, w)
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         self._drop_idx = self._select_drop()
@@ -97,11 +95,9 @@ class DARTModel(GBDTModel):
                 for vi in range(len(self.valid_sets)):
                     vds, vb, vs = self.valid_sets[vi]
                     dt = st["trees"][k]
-                    ns = add_tree_score(
-                        vs[:, k], vb, dt.split_feature, dt.threshold_bin,
-                        dt.default_left, dt.left_child, dt.right_child,
-                        self.na_bin_dev, dt.leaf_value,
-                        jnp.float32(new_factor - 1.0), steps=dt.steps)
+                    from .gbdt import _apply_tree
+                    ns = _apply_tree(vs[:, k], vb, dt, self.na_bin_dev,
+                                     new_factor - 1.0)
                     self.valid_sets[vi] = (vds, vb, vs.at[:, k].set(ns))
             # scale dropped trees and restore their (rescaled) contribution
             for ti in self._drop_idx:

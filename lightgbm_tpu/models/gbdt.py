@@ -32,7 +32,8 @@ class _DeviceTree:
     """Per-tree device arrays for fast binned traversal."""
 
     __slots__ = ("split_feature", "threshold_bin", "default_left",
-                 "left_child", "right_child", "leaf_value", "steps")
+                 "left_child", "right_child", "is_cat_node", "cat_rank",
+                 "leaf_value", "steps")
 
     def __init__(self, arrays: TreeArrays, leaf_value: np.ndarray, steps: int):
         self.split_feature = arrays.split_feature
@@ -40,8 +41,19 @@ class _DeviceTree:
         self.default_left = arrays.default_left
         self.left_child = arrays.left_child
         self.right_child = arrays.right_child
+        self.is_cat_node = arrays.is_cat_node
+        self.cat_rank = arrays.cat_rank
         self.leaf_value = jnp.asarray(leaf_value, jnp.float32)
         self.steps = steps
+
+
+def _apply_tree(score_vec, binned, dt: _DeviceTree, na_bin, weight: float):
+    """score_vec += weight * tree(binned)."""
+    return add_tree_score(
+        score_vec, binned, dt.split_feature, dt.threshold_bin,
+        dt.default_left, dt.left_child, dt.right_child, na_bin,
+        dt.is_cat_node, dt.cat_rank, dt.leaf_value, jnp.float32(weight),
+        steps=dt.steps)
 
 
 class GBDTModel:
@@ -71,6 +83,10 @@ class GBDTModel:
                             np.int32)
         self.num_bin_dev = jnp.asarray(num_bin)
         self.na_bin_dev = jnp.asarray(na_bin)
+        from ..binning import BinType
+        is_cat = np.asarray([ds.bin_mappers[f].bin_type == BinType.CATEGORICAL
+                             for f in ds.used_features], bool)
+        self.is_cat_dev = jnp.asarray(is_cat) if is_cat.any() else None
         self.max_bin = int(num_bin.max())
 
         self.split_params = SplitParams(
@@ -81,11 +97,44 @@ class GBDTModel:
             min_gain_to_split=config.min_gain_to_split,
             max_delta_step=config.max_delta_step,
             path_smooth=config.path_smooth,
+            cat_l2=config.cat_l2,
+            cat_smooth=config.cat_smooth,
+            max_cat_threshold=config.max_cat_threshold,
+            max_cat_to_onehot=config.max_cat_to_onehot,
+            min_data_per_group=config.min_data_per_group,
         )
-        self.grower = make_grower(
-            num_leaves=config.num_leaves, num_bins=self.max_bin,
-            params=self.split_params, max_depth=config.max_depth,
-            block_rows=config.rows_per_block, hist_reduce=hist_reduce)
+        mono = None
+        if config.monotone_constraints:
+            mc_full = np.zeros(ds.num_total_features, np.int32)
+            mc_in = np.asarray(config.monotone_constraints, np.int32)
+            mc_full[:len(mc_in)] = mc_in
+            mono = mc_full[np.asarray(ds.used_features)]
+        inter = self._interaction_allow(config, ds)
+        has_node_controls = (mono is not None and np.any(mono)) \
+            or inter is not None or config.feature_fraction_bynode < 1.0
+
+        if hist_reduce is None and config.tpu_learner == "partitioned":
+            # single-chip performance learner (grower_partitioned.py):
+            # histogram work ∝ smaller child, like the reference
+            from ..grower_partitioned import PartitionedGrower
+            self.grower = PartitionedGrower(
+                num_leaves=config.num_leaves, num_bins=self.max_bin,
+                params=self.split_params, max_depth=config.max_depth,
+                block_rows=config.rows_per_block, mono=mono,
+                interaction_allow=inter,
+                bynode_frac=config.feature_fraction_bynode,
+                bynode_seed=config.feature_fraction_seed + 1)
+        else:
+            if has_node_controls:
+                raise ValueError(
+                    "monotone/interaction constraints and "
+                    "feature_fraction_bynode currently require the "
+                    "partitioned learner (tpu_learner=partitioned, "
+                    "single-chip)")
+            self.grower = make_grower(
+                num_leaves=config.num_leaves, num_bins=self.max_bin,
+                params=self.split_params, max_depth=config.max_depth,
+                block_rows=config.rows_per_block, hist_reduce=hist_reduce)
 
         if self.objective is not None:
             self.objective.init(ds.metadata, self.num_data)
@@ -122,11 +171,9 @@ class GBDTModel:
         # replay existing trees (continued training)
         for ti, dt in enumerate(self.device_trees):
             k = ti % self.num_class
-            score = score.at[:, k].set(add_tree_score(
-                score[:, k], binned, dt.split_feature, dt.threshold_bin,
-                dt.default_left, dt.left_child, dt.right_child,
-                self.na_bin_dev, dt.leaf_value,
-                jnp.float32(self.tree_weights[ti]), steps=dt.steps))
+            score = score.at[:, k].set(_apply_tree(
+                score[:, k], binned, dt, self.na_bin_dev,
+                self.tree_weights[ti]))
         self.valid_sets.append((valid, binned, score))
 
     # -- sampling (gbdt.cpp:230 Bagging + goss.hpp) ------------------------
@@ -235,8 +282,13 @@ class GBDTModel:
             else:
                 w = jnp.ones(self.num_data, jnp.float32)
             vals = jnp.stack([g * w, h * w, w], axis=1)
-            arrays = self.grower(self.binned_dev, vals, fmask,
-                                 self.num_bin_dev, self.na_bin_dev)
+            if self.is_cat_dev is not None:
+                arrays = self.grower(self.binned_dev, vals, fmask,
+                                     self.num_bin_dev, self.na_bin_dev,
+                                     is_cat=self.is_cat_dev)
+            else:
+                arrays = self.grower(self.binned_dev, vals, fmask,
+                                     self.num_bin_dev, self.na_bin_dev)
             nl = int(arrays.num_leaves)
             leaf_values = np.asarray(arrays.leaf_value, np.float64).copy()
             if nl <= 1:
@@ -283,11 +335,7 @@ class GBDTModel:
 
             # validation score updates
             for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
-                ns = add_tree_score(
-                    vscore[:, k], vbinned, dt.split_feature, dt.threshold_bin,
-                    dt.default_left, dt.left_child, dt.right_child,
-                    self.na_bin_dev, dt.leaf_value, jnp.float32(1.0),
-                    steps=dt.steps)
+                ns = _apply_tree(vscore[:, k], vbinned, dt, self.na_bin_dev, 1.0)
                 self.valid_sets[vi] = (vds, vbinned, vscore.at[:, k].set(ns))
 
         self.models.extend(iter_trees)
@@ -305,11 +353,8 @@ class GBDTModel:
             self.score = self.score.at[:, k].add(-delta)
             dt = st["trees"][k]
             for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
-                ns = add_tree_score(
-                    vscore[:, k], vbinned, dt.split_feature, dt.threshold_bin,
-                    dt.default_left, dt.left_child, dt.right_child,
-                    self.na_bin_dev, dt.leaf_value, jnp.float32(-1.0),
-                    steps=dt.steps)
+                ns = _apply_tree(vscore[:, k], vbinned, dt, self.na_bin_dev,
+                                 -1.0)
                 self.valid_sets[vi] = (vds, vbinned, vscore.at[:, k].set(ns))
         del self.models[-self.num_class:]
         del self.device_trees[-self.num_class:]

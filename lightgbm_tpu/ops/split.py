@@ -34,18 +34,32 @@ class SplitParams(NamedTuple):
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
     path_smooth: float = 0.0
+    # categorical (feature_histogram.hpp:278 FindBestThresholdCategoricalInner)
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
 
 
 class SplitResult(NamedTuple):
-    """Per-leaf best split (SplitInfo analog, split_info.hpp:55)."""
+    """Per-leaf best split (SplitInfo analog, split_info.hpp:55).
+
+    The decision is uniformly "go left iff bin_rank[bin] <= threshold":
+    numerical splits use the identity rank (bin order), categorical splits
+    the gradient-ratio ordering of the chosen subset — one partition
+    predicate serves both (tree.h Numerical/CategoricalDecision collapse).
+    """
     gain: jax.Array          # f32; <=0 / -inf when invalid
     feature: jax.Array       # int32 (used-feature slot)
-    threshold: jax.Array     # int32 bin threshold (go left if bin <= threshold)
+    threshold: jax.Array     # int32 rank threshold
     default_left: jax.Array  # bool
     left_sum: jax.Array      # [3] (g, h, count)
     right_sum: jax.Array     # [3]
     left_output: jax.Array   # f32 leaf output
     right_output: jax.Array  # f32
+    is_cat: jax.Array        # bool
+    bin_rank: jax.Array      # [B] int32 rank of each bin in the decision order
 
 
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
@@ -85,18 +99,9 @@ def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=None):
     return -(2.0 * tg * out + (sum_h + p.lambda_l2) * out * out)
 
 
-def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
-                    na_bin: jax.Array, feature_mask: jax.Array,
-                    params: SplitParams, parent_output: jax.Array = None
-                    ) -> SplitResult:
-    """Best (feature, threshold-bin, missing-direction) for one leaf.
-
-    hist:         [F, B, 3] f32 — per-feature histograms (g, h, count)
-    total:        [3] parent aggregates
-    num_bin:      [F] int32 valid bin count per feature
-    na_bin:       [F] int32 NaN-bin index or -1
-    feature_mask: [F] bool — feature_fraction / interaction constraint mask
-    """
+def _numerical_candidates(hist, total, num_bin, na_bin, feature_mask,
+                          params: SplitParams, parent_out):
+    """Gain tensor [2, F, B] over (missing-direction, feature, threshold)."""
     f, b, _ = hist.shape
     cum = jnp.cumsum(hist, axis=1)                      # [F, B, 3] inclusive
     bins = jnp.arange(b, dtype=jnp.int32)
@@ -118,8 +123,6 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
     gl, hl, cl = lefts[..., 0], lefts[..., 1], lefts[..., 2]
     gr, hr, cr = rights[..., 0], rights[..., 1], rights[..., 2]
 
-    parent_out = leaf_output(total[0], total[1], params) if parent_output is None \
-        else parent_output
     gain_l = leaf_gain(gl, hl, params, parent_out)
     gain_r = leaf_gain(gr, hr, params, parent_out)
     gain_shift = leaf_gain(total[0], total[1], params)
@@ -140,26 +143,196 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
                         jnp.broadcast_to(has_na[:, None], (f, b))], axis=0)
 
     gains = jnp.where(valid, split_gain, kMinScore)     # [2, F, B]
-    flat = gains.reshape(-1)
-    best = jnp.argmax(flat)                             # first max: dir0, low f, low b
-    best_gain = flat[best]
-    best_dir = best // (f * b)
-    rem = best % (f * b)
-    best_f = (rem // b).astype(jnp.int32)
-    best_b = (rem % b).astype(jnp.int32)
+    return gains, lefts
 
-    sel = lefts[best_dir, best_f, best_b]               # [3]
-    left_sum = sel
-    right_sum = total - sel
-    lo = leaf_output(left_sum[0], left_sum[1], params, parent_out)
-    ro = leaf_output(right_sum[0], right_sum[1], params, parent_out)
+
+def _categorical_candidates(hist, total, num_bin, cat_mask,
+                            params: SplitParams, parent_out):
+    """Categorical subset candidates (FindBestThresholdCategoricalInner,
+    feature_histogram.hpp:278): one-vs-rest when few categories, else a
+    two-direction scan over bins sorted by grad/hess ratio.
+
+    Returns (gains [3, F, B], lefts [3, F, B, 3], orders [3, F, B]):
+    scan modes = (one-vs-rest, ratio-ascending, ratio-descending); ``orders``
+    maps scan position -> bin id.
+    """
+    f, b, _ = hist.shape
+    pcat = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
+    g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+    used = c >= max(0.5, float(params.min_data_per_group) - 0.5)
+    n_used = used.sum(axis=1)                            # [F]
+    positions = jnp.arange(b, dtype=jnp.int32)
+
+    # ratio ordering (cat_smooth regularized), unused bins pushed last
+    ratio = g / (h + params.cat_smooth)
+    big = jnp.float32(1e30)
+    key_asc = jnp.where(used, ratio, big)
+    order_asc = jnp.argsort(key_asc, axis=1).astype(jnp.int32)    # [F, B]
+    key_desc = jnp.where(used, -ratio, big)
+    order_desc = jnp.argsort(key_desc, axis=1).astype(jnp.int32)
+    order_ovr = jnp.broadcast_to(positions[None, :], (f, b)).astype(jnp.int32)
+    orders = jnp.stack([order_ovr, order_asc, order_desc])         # [3, F, B]
+
+    hist3 = jnp.broadcast_to(hist[None], (3, f, b, 3))
+    sorted_hist = jnp.take_along_axis(hist3, orders[..., None], axis=2)
+    cum = jnp.cumsum(sorted_hist, axis=2)                # [3, F, B, 3]
+    # mode 0 = one-vs-rest: left = single bin at this position
+    lefts = cum.at[0].set(sorted_hist[0])
+    rights = total[None, None, None, :] - lefts
+
+    gl, hl, cl = lefts[..., 0], lefts[..., 1], lefts[..., 2]
+    gr, hr, cr = rights[..., 0], rights[..., 1], rights[..., 2]
+    gain_l = leaf_gain(gl, hl, pcat, parent_out)
+    gain_r = leaf_gain(gr, hr, pcat, parent_out)
+    gain_shift = leaf_gain(total[0], total[1], pcat)
+    split_gain = gain_l + gain_r - (gain_shift + params.min_gain_to_split)
+
+    md = float(params.min_data_in_leaf) - 0.5
+    mh = params.min_sum_hessian_in_leaf
+    pos = positions[None, None, :]
+    few = (n_used <= params.max_cat_to_onehot)[None, :, None]      # [1, F, 1]
+    # mode 0 valid at positions whose bin is used; modes 1-2 at prefix
+    # lengths 1..min(max_cat_threshold, n_used-1)
+    used3 = jnp.take_along_axis(jnp.broadcast_to(used[None], (3, f, b)),
+                                orders, axis=2)
+    valid = jnp.zeros((3, f, b), bool)
+    valid = valid.at[0].set(few[0] & used3[0])
+    k_max = jnp.minimum(params.max_cat_threshold,
+                        n_used - 1)[None, :, None]                 # prefix cap
+    prefix_ok = (pos < k_max) & (~few)
+    valid = valid.at[1].set(prefix_ok[0] & used3[1])
+    valid = valid.at[2].set(prefix_ok[0] & used3[2])
+    valid &= cat_mask[None, :, None]
+    valid &= (cl >= md) & (cr >= md)
+    valid &= (hl >= mh) & (hr >= mh)
+    valid &= split_gain > kEpsilon
+
+    gains = jnp.where(valid, split_gain, kMinScore)
+    return gains, lefts, orders
+
+
+def _monotone_adjust(gains, lefts, total, mono, out_lo, out_hi, dir_axis,
+                     params: SplitParams, parent_out):
+    """Monotone-constraint filter ('basic' method,
+    monotone_constraints.hpp BasicLeafConstraints): clamp candidate child
+    outputs to the leaf's allowed range, recompute gains with the clamped
+    outputs (GetLeafGainGivenOutput), and invalidate splits whose direction
+    violates the feature's monotonicity."""
+    rights = total[None, None, None, :] - lefts
+    out_l = leaf_output(lefts[..., 0], lefts[..., 1], params, parent_out)
+    out_r = leaf_output(rights[..., 0], rights[..., 1], params, parent_out)
+    cl_l = jnp.clip(out_l, out_lo, out_hi)
+    cl_r = jnp.clip(out_r, out_lo, out_hi)
+
+    def gain_given(sums, out):
+        tg = threshold_l1(sums[..., 0], params.lambda_l1)
+        return -(2.0 * tg * out + (sums[..., 1] + params.lambda_l2) * out * out)
+
+    mono_f = mono[None, :, None]                       # broadcast over dirs/bins
+    active = mono_f != 0
+    clamped = (cl_l != out_l) | (cl_r != out_r)
+    need = active | clamped
+    new_gain = (gain_given(lefts, cl_l) + gain_given(rights, cl_r)
+                - (leaf_gain(total[0], total[1], params)
+                   + params.min_gain_to_split))
+    gains = jnp.where(need, jnp.where(clamped | active, new_gain, gains), gains)
+    ok = jnp.where(mono_f > 0, cl_l <= cl_r,
+                   jnp.where(mono_f < 0, cl_l >= cl_r, True))
+    return jnp.where(ok & (gains > kEpsilon), gains, kMinScore)
+
+
+def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
+                    na_bin: jax.Array, feature_mask: jax.Array,
+                    params: SplitParams, parent_output: jax.Array = None,
+                    is_cat: jax.Array = None, mono: jax.Array = None,
+                    out_lo: jax.Array = None, out_hi: jax.Array = None
+                    ) -> SplitResult:
+    """Best split for one leaf across numerical and categorical features.
+
+    hist:         [F, B, 3] f32 — per-feature histograms (g, h, count)
+    total:        [3] parent aggregates
+    num_bin:      [F] int32 valid bin count per feature
+    na_bin:       [F] int32 NaN-bin index or -1
+    feature_mask: [F] bool — feature_fraction / interaction constraint mask
+    is_cat:       [F] bool — categorical feature flags (None = none)
+    mono:         [F] int32 — monotone constraints -1/0/+1 (None = none)
+    out_lo/out_hi: scalar allowed output range of this leaf (monotone)
+    """
+    f, b, _ = hist.shape
+    parent_out = leaf_output(total[0], total[1], params) \
+        if parent_output is None else parent_output
+
+    num_mask = feature_mask if is_cat is None else (feature_mask & (~is_cat))
+    ngains, nlefts = _numerical_candidates(hist, total, num_bin, na_bin,
+                                           num_mask, params, parent_out)
+    if mono is not None:
+        ngains = _monotone_adjust(ngains, nlefts, total, mono, out_lo, out_hi,
+                                  0, params, parent_out)
+    nflat = ngains.reshape(-1)
+    nbest = jnp.argmax(nflat)
+    nbest_gain = nflat[nbest]
+
+    if is_cat is not None:
+        cat_mask = feature_mask & is_cat
+        cgains, clefts, corders = _categorical_candidates(
+            hist, total, num_bin, cat_mask, params, parent_out)
+        cflat = cgains.reshape(-1)
+        cbest = jnp.argmax(cflat)
+        cbest_gain = cflat[cbest]
+    else:
+        cbest_gain = jnp.float32(kMinScore)
+
+    use_cat = (is_cat is not None) and True
+    iota_rank = jnp.arange(b, dtype=jnp.int32)
+
+    def build_numerical():
+        best_dir = nbest // (f * b)
+        rem = nbest % (f * b)
+        best_f = (rem // b).astype(jnp.int32)
+        best_b = (rem % b).astype(jnp.int32)
+        left_sum = nlefts[best_dir, best_f, best_b]
+        return (nbest_gain, best_f, best_b, best_dir == 1, left_sum,
+                jnp.bool_(False), iota_rank)
+
+    if is_cat is None:
+        g_, f_, t_, d_, ls_, ic_, rank_ = build_numerical()
+    else:
+        def build_categorical():
+            mode = cbest // (f * b)
+            rem = cbest % (f * b)
+            best_f = (rem // b).astype(jnp.int32)
+            pos = (rem % b).astype(jnp.int32)
+            left_sum = clefts[mode, best_f, pos]
+            order = corders[mode, best_f]                 # [B] pos -> bin
+            rank = jnp.argsort(order).astype(jnp.int32)   # bin -> pos
+            # one-vs-rest: single bin at `pos` goes left -> rank 0 only
+            rank_ovr = jnp.where(iota_rank == order[pos], 0, b).astype(jnp.int32)
+            rank = jnp.where(mode == 0, rank_ovr, rank)
+            thr = jnp.where(mode == 0, 0, pos).astype(jnp.int32)
+            return (cbest_gain, best_f, thr, jnp.bool_(False), left_sum,
+                    jnp.bool_(True), rank)
+
+        take_num = nbest_gain >= cbest_gain
+        nvals = build_numerical()
+        cvals = build_categorical()
+        g_, f_, t_, d_, ls_, ic_, rank_ = jax.tree.map(
+            lambda a, c: jnp.where(take_num, a, c), nvals, cvals)
+
+    right_sum = total - ls_
+    # categorical splits regularize leaf outputs with l2 + cat_l2
+    pcat = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
+    lo = jnp.where(ic_, leaf_output(ls_[0], ls_[1], pcat, parent_out),
+                   leaf_output(ls_[0], ls_[1], params, parent_out))
+    ro = jnp.where(ic_, leaf_output(right_sum[0], right_sum[1], pcat, parent_out),
+                   leaf_output(right_sum[0], right_sum[1], params, parent_out))
+    if mono is not None:
+        lo = jnp.clip(lo, out_lo, out_hi)
+        ro = jnp.clip(ro, out_lo, out_hi)
     return SplitResult(
-        gain=best_gain,
-        feature=best_f,
-        threshold=best_b,
-        default_left=(best_dir == 1),
-        left_sum=left_sum,
-        right_sum=right_sum,
+        gain=g_, feature=f_.astype(jnp.int32),
+        threshold=t_.astype(jnp.int32), default_left=d_,
+        left_sum=ls_, right_sum=right_sum,
         left_output=lo.astype(jnp.float32),
         right_output=ro.astype(jnp.float32),
+        is_cat=ic_, bin_rank=rank_.astype(jnp.int32),
     )

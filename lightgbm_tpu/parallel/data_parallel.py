@@ -63,10 +63,16 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         default_left=P(), left_child=P(), right_child=P(), split_gain=P(),
         leaf_value=P(), leaf_weight=P(), leaf_count=P(), internal_value=P(),
         internal_weight=P(), internal_count=P(), leaf_depth=P(),
-        leaf_of_row=P(axis))
+        leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P())
 
     f = jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(), P(), P()),
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
         out_specs=out_specs, check_vma=False)
-    return jax.jit(f)
+
+    def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
+        if is_cat is None:
+            is_cat = jnp.zeros(num_bin.shape[0], bool)
+        return f(binned, vals, feature_mask, num_bin, na_bin, na_bin, is_cat)
+
+    return jax.jit(grow)

@@ -5,6 +5,10 @@ Used for validation-set score updates each iteration (the reference's
 batched leaf prediction.  The traversal is a fixed-depth ``fori_loop`` of
 vectorized gathers: every row walks one level per step; finished rows carry
 their (negative-encoded) leaf id unchanged — static shapes, no divergence.
+
+Numerical and categorical decisions share one predicate: per-node
+``cat_rank`` maps bin -> decision rank (identity for numerical nodes), go
+left iff rank <= threshold (see ops/split.py SplitResult).
 """
 
 from __future__ import annotations
@@ -18,13 +22,9 @@ from jax import lax
 
 @functools.partial(jax.jit, static_argnames=("steps",))
 def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
-                         left_child, right_child, na_bin, *, steps: int):
-    """Return the leaf index for every row of ``binned`` [N, F].
-
-    Tree arrays are the grower's (bin-space thresholds: go left iff
-    bin <= threshold, NaN-bin rows follow ``default_left``).
-    ``steps`` must be >= tree depth.
-    """
+                         left_child, right_child, na_bin, is_cat_node,
+                         cat_rank, *, steps: int):
+    """Return the leaf index for every row of ``binned`` [N, F]."""
     n = binned.shape[0]
     node = jnp.zeros(n, jnp.int32)
 
@@ -35,8 +35,9 @@ def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
         v = jnp.take_along_axis(binned, f[:, None].astype(jnp.int32),
                                 axis=1)[:, 0].astype(jnp.int32)
         nb = na_bin[f]
-        is_na = (nb >= 0) & (v == nb)
-        go_left = jnp.where(is_na, default_left[nid], v <= threshold_bin[nid])
+        is_na = (nb >= 0) & (v == nb) & (~is_cat_node[nid])
+        rank = cat_rank[nid, v]
+        go_left = jnp.where(is_na, default_left[nid], rank <= threshold_bin[nid])
         nxt = jnp.where(go_left, left_child[nid], right_child[nid])
         return jnp.where(internal, nxt, node)
 
@@ -46,12 +47,12 @@ def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
 
 @functools.partial(jax.jit, static_argnames=("steps",))
 def add_tree_score(score, binned, split_feature, threshold_bin, default_left,
-                   left_child, right_child, na_bin, leaf_value, weight,
-                   *, steps: int):
+                   left_child, right_child, na_bin, is_cat_node, cat_rank,
+                   leaf_value, weight, *, steps: int):
     """score += weight * tree(binned) — incremental ScoreUpdater step."""
     leaf = traverse_tree_binned(binned, split_feature, threshold_bin,
                                 default_left, left_child, right_child,
-                                na_bin, steps=steps)
+                                na_bin, is_cat_node, cat_rank, steps=steps)
     return score + weight * jnp.take(leaf_value, leaf)
 
 

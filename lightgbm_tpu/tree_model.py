@@ -78,6 +78,8 @@ class Tree:
 
         t.threshold = np.zeros(n, np.float64)
         t.decision_type = np.zeros(n, np.int32)
+        is_cat_node = np.asarray(arrays.is_cat_node)[:n]
+        cat_rank = np.asarray(arrays.cat_rank)[:n]
         for i in range(n):
             f = t.split_feature[i]
             m = mappers[f]
@@ -86,10 +88,15 @@ class Tree:
                 dt |= 1 << _MISSING_SHIFT
             elif m.missing_type == MissingType.NAN:
                 dt |= 2 << _MISSING_SHIFT
-            if m.bin_type == BinType.CATEGORICAL:
-                # left set = categories of bins 0..threshold_bin (count-ordered)
+            if is_cat_node[i]:
+                # left set = bins whose decision rank <= threshold
+                # (gradient-ratio subset, ops/split.py categorical scan)
                 dt |= _CAT_BIT
-                cats = m.categories[:t.threshold_bin[i] + 1]
+                rank = cat_rank[i]
+                ncat = len(m.categories)
+                sel = [b for b in range(min(ncat, len(rank)))
+                       if rank[b] <= t.threshold_bin[i]]
+                cats = m.categories[sel]
                 t.threshold[i] = t._add_cat_bitset(cats)
             else:
                 if dl[i]:

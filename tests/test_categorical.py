@@ -1,0 +1,99 @@
+"""Categorical split tests (test_engine.py categorical-handling analog).
+
+The informative category subset is deliberately NOT count-ordered, so only
+the gradient-ratio sorted-subset search (feature_histogram.hpp:278 analog)
+can find it.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.metrics import _auc
+
+
+def _cat_data(n=4000, seed=0, n_cats=12):
+    rs = np.random.RandomState(seed)
+    # category frequencies unrelated to label effect
+    freqs = rs.dirichlet(np.ones(n_cats) * 2)
+    cats = rs.choice(n_cats, size=n, p=freqs)
+    # "good" categories = odd ids (interleaved with frequencies)
+    good = {c for c in range(n_cats) if c % 2 == 1}
+    noise = rs.randn(n, 3)
+    logit = np.where(np.isin(cats, list(good)), 1.5, -1.5) \
+        + 0.3 * noise[:, 0] + 0.2 * rs.randn(n)
+    y = (logit > 0).astype(np.float32)
+    x = np.column_stack([cats.astype(np.float64), noise])
+    return x, y, good
+
+
+class TestCategoricalSplits:
+    def test_subset_split_quality(self):
+        x, y, good = _cat_data()
+        ds = lgb.Dataset(x, label=y, categorical_feature=[0],
+                         params={"max_bin": 63})
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "min_data_per_group": 1,
+             "cat_smooth": 1.0, "cat_l2": 1.0}
+        bst = lgb.train(p, ds, num_boost_round=20)
+        auc = _auc(y, bst.predict(x, raw_score=True), None)
+        assert auc > 0.93, f"categorical AUC too low: {auc}"
+        # the categorical feature must dominate importance
+        imp = bst.feature_importance("gain")
+        assert imp[0] > imp[1:].sum()
+
+    def test_model_io_with_categorical(self, tmp_path):
+        x, y, good = _cat_data(seed=1)
+        ds = lgb.Dataset(x, label=y, categorical_feature=[0],
+                         params={"max_bin": 63})
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+             "min_data_per_group": 1, "cat_smooth": 1.0}
+        bst = lgb.train(p, ds, num_boost_round=8)
+        path = str(tmp_path / "cat_model.txt")
+        bst.save_model(path)
+        s = open(path).read()
+        assert "num_cat=" in s
+        bst2 = lgb.Booster(model_file=path)
+        np.testing.assert_allclose(bst.predict(x[:200], raw_score=True),
+                                   bst2.predict(x[:200], raw_score=True),
+                                   rtol=1e-6, atol=1e-10)
+
+    def test_unseen_category_goes_right(self):
+        x, y, good = _cat_data(seed=2)
+        ds = lgb.Dataset(x, label=y, categorical_feature=[0],
+                         params={"max_bin": 63})
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+             "min_data_per_group": 1, "cat_smooth": 1.0}
+        bst = lgb.train(p, ds, num_boost_round=8)
+        xt = x[:10].copy()
+        xt[:, 0] = 999.0   # unseen category
+        pred = bst.predict(xt)
+        assert np.isfinite(pred).all()
+
+    def test_onehot_mode_few_categories(self):
+        rs = np.random.RandomState(3)
+        n = 3000
+        cats = rs.choice(3, size=n)
+        y = (cats == 1).astype(np.float32)
+        x = np.column_stack([cats.astype(np.float64), rs.randn(n, 2)])
+        ds = lgb.Dataset(x, label=y, categorical_feature=[0])
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "max_cat_to_onehot": 4, "min_data_per_group": 1}
+        bst = lgb.train(p, ds, num_boost_round=10)
+        pred = bst.predict(x)
+        acc = ((pred > 0.5) == y).mean()
+        assert acc > 0.99, f"one-vs-rest split should isolate category: {acc}"
+
+    def test_pandas_category_dtype(self):
+        pd = pytest.importorskip("pandas")
+        x, y, good = _cat_data(seed=4)
+        df = pd.DataFrame({
+            "cat": pd.Categorical([f"c{int(v)}" for v in x[:, 0]]),
+            "a": x[:, 1], "b": x[:, 2],
+        })
+        from lightgbm_tpu.sklearn import LGBMClassifier
+        m = LGBMClassifier(n_estimators=10, num_leaves=15, max_bin=63,
+                           min_data_per_group=1, cat_smooth=1.0)
+        m.fit(df, y)
+        pred = m.predict_proba(df)[:, 1]
+        assert _auc(y, pred, None) > 0.9

@@ -1,0 +1,71 @@
+"""The partitioned (performance) grower must match the jitted masked grower
+exactly — same trees, same partitions (SURVEY.md §7: subtraction trick +
+DataPartition parity)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.grower import make_grower
+from lightgbm_tpu.grower_partitioned import PartitionedGrower
+from lightgbm_tpu.ops.split import SplitParams
+
+
+def _data(n=3000, f=6, b=16, seed=0, bag=False):
+    rng = np.random.RandomState(seed)
+    binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    y = (binned[:, 2] >= b // 2).astype(np.float32) \
+        + 0.3 * rng.randn(n).astype(np.float32)
+    g = (0.5 - y).astype(np.float32)
+    w = (rng.rand(n) < 0.7).astype(np.float32) if bag else np.ones(n, np.float32)
+    vals = np.stack([g * w, w, w], axis=1)
+    return binned, vals
+
+
+@pytest.mark.parametrize("bag", [False, True])
+@pytest.mark.parametrize("na", [False, True])
+def test_matches_masked_grower(bag, na):
+    binned, vals = _data(bag=bag)
+    n, f = binned.shape
+    B, L = 16, 8
+    if na:
+        # make last bin of feature 0 the NaN bin
+        na_bin = np.full(f, -1, np.int32)
+        na_bin[0] = B - 1
+    else:
+        na_bin = np.full(f, -1, np.int32)
+    p = SplitParams(min_data_in_leaf=5)
+    nb = jnp.full(f, B, jnp.int32)
+    nab = jnp.asarray(na_bin)
+    fm = jnp.ones(f, bool)
+
+    masked = make_grower(num_leaves=L, num_bins=B, params=p)
+    t1 = masked(jnp.asarray(binned), jnp.asarray(vals), fm, nb, nab)
+    part = PartitionedGrower(num_leaves=L, num_bins=B, params=p)
+    t2 = part(jnp.asarray(binned), jnp.asarray(vals), fm, nb, nab)
+
+    assert int(t1.num_leaves) == int(t2.num_leaves) > 2
+    nl = int(t1.num_leaves)
+    for k in ("split_feature", "threshold_bin", "default_left",
+              "left_child", "right_child"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t1, k))[:nl - 1],
+            np.asarray(getattr(t2, k))[:nl - 1], err_msg=k)
+    np.testing.assert_allclose(np.asarray(t1.leaf_value)[:nl],
+                               np.asarray(t2.leaf_value)[:nl],
+                               rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(t1.leaf_count)[:nl],
+                               np.asarray(t2.leaf_count)[:nl], atol=0.5)
+    np.testing.assert_array_equal(np.asarray(t1.leaf_of_row),
+                                  np.asarray(t2.leaf_of_row))
+
+
+def test_max_depth_respected():
+    binned, vals = _data()
+    f = binned.shape[1]
+    B, L = 16, 16
+    p = SplitParams(min_data_in_leaf=5)
+    part = PartitionedGrower(num_leaves=L, num_bins=B, params=p, max_depth=2)
+    t = part(jnp.asarray(binned), jnp.asarray(vals), jnp.ones(f, bool),
+             jnp.full(f, B, jnp.int32), jnp.full(f, -1, jnp.int32))
+    assert int(t.num_leaves) <= 4
