@@ -98,6 +98,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 hist_reduce: Optional[Callable] = None,
                 hist_view: Optional[Callable] = None,
                 select_best: Optional[Callable] = None,
+                subtract: bool = True,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
@@ -119,6 +120,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     reduce_fn = hist_reduce or (lambda h: h)
     view_fn = hist_view or (lambda b: b)
     select_fn = select_best or (lambda r: r)
+    use_subtraction = subtract
 
     def _hist(binned_view, vals):
         h = compute_histogram(binned_view, vals, num_bins=B,
@@ -217,7 +219,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 smaller_id = jnp.where(smaller_left, leaf, new_leaf)
                 mask = (leaf_of_row == smaller_id).astype(vals.dtype)[:, None]
                 hist_small = _hist(binned_view, vals * mask)
-                hist_large = st.hist[leaf] - hist_small
+                if use_subtraction:
+                    hist_large = st.hist[leaf] - hist_small
+                else:
+                    # voting-parallel: per-split feature votes make the
+                    # reduced hist feature sets differ between parent and
+                    # children, so the larger child is constructed too
+                    lmask = (leaf_of_row == jnp.where(smaller_left, new_leaf,
+                                                      leaf)) \
+                        .astype(vals.dtype)[:, None]
+                    hist_large = _hist(binned_view, vals * lmask)
                 hl_leaf = jnp.where(smaller_left, hist_small, hist_large)
                 hl_new = jnp.where(smaller_left, hist_large, hist_small)
                 hist = st.hist.at[leaf].set(hl_leaf).at[new_leaf].set(hl_new)

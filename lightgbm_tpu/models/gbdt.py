@@ -159,6 +159,35 @@ class GBDTModel:
         self._goss = config.data_sample_strategy == "goss"
         self._last_iter_state: Optional[dict] = None
 
+    @staticmethod
+    def _interaction_allow(config: Config, ds: Dataset):
+        """Parse interaction_constraints ("[0,1],[2,3]" over original feature
+        indices) into an allowed-interaction matrix over used-feature slots
+        (ColSampler analog, col_sampler.hpp)."""
+        spec = config.interaction_constraints
+        if not spec:
+            return None
+        groups: List[List[int]] = []
+        for part in spec.replace(" ", "").strip("[]").split("],["):
+            if part:
+                groups.append([int(t) for t in part.split(",") if t != ""])
+        if not groups:
+            return None
+        slot_of_orig = {f: i for i, f in enumerate(ds.used_features)}
+        nf = len(ds.used_features)
+        allow = np.zeros((nf, nf), bool)
+        for slot, orig in enumerate(ds.used_features):
+            in_any = False
+            for grp in groups:
+                if orig in grp:
+                    in_any = True
+                    for member in grp:
+                        if member in slot_of_orig:
+                            allow[slot, slot_of_orig[member]] = True
+            if not in_any:
+                allow[slot, slot] = True
+        return allow
+
     # -- plumbing ----------------------------------------------------------
     def add_valid_set(self, valid: Dataset) -> None:
         valid.construct(self.config)

@@ -229,16 +229,15 @@ def _monotone_adjust(gains, lefts, total, mono, out_lo, out_hi, dir_axis,
         return -(2.0 * tg * out + (sums[..., 1] + params.lambda_l2) * out * out)
 
     mono_f = mono[None, :, None]                       # broadcast over dirs/bins
-    active = mono_f != 0
+    was_valid = gains > kMinScore
     clamped = (cl_l != out_l) | (cl_r != out_r)
-    need = active | clamped
     new_gain = (gain_given(lefts, cl_l) + gain_given(rights, cl_r)
                 - (leaf_gain(total[0], total[1], params)
                    + params.min_gain_to_split))
-    gains = jnp.where(need, jnp.where(clamped | active, new_gain, gains), gains)
+    gains = jnp.where(was_valid & clamped, new_gain, gains)
     ok = jnp.where(mono_f > 0, cl_l <= cl_r,
                    jnp.where(mono_f < 0, cl_l >= cl_r, True))
-    return jnp.where(ok & (gains > kEpsilon), gains, kMinScore)
+    return jnp.where(was_valid & ok & (gains > kEpsilon), gains, kMinScore)
 
 
 def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,

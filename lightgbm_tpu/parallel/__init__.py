@@ -1,3 +1,4 @@
-from .mesh import make_mesh, default_mesh
+from .mesh import make_mesh, default_mesh, init_distributed
 from .data_parallel import make_dp_grower, shard_rows, pad_to_multiple
 from .feature_parallel import make_fp_grower
+from .voting_parallel import make_voting_grower

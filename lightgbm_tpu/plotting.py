@@ -1,0 +1,176 @@
+"""Plotting utilities (reference: python-package/lightgbm/plotting.py).
+
+``plot_importance`` / ``plot_split_value_histogram`` / ``plot_metric`` /
+``plot_tree`` / ``create_tree_digraph`` with matplotlib / graphviz gated at
+call time like the reference (plotting.py _check_not_tuple_of_2_elements
+import pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from .booster import Booster
+from .sklearn import LGBMModel
+
+
+def _to_booster(model) -> Booster:
+    if isinstance(model, LGBMModel):
+        return model.booster_
+    if isinstance(model, Booster):
+        return model
+    raise TypeError("model must be a Booster or LGBMModel")
+
+
+def _import_matplotlib():
+    try:
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError as e:
+        raise ImportError("matplotlib is required for plotting") from e
+
+
+def plot_importance(model, ax=None, height: float = 0.2, xlim=None, ylim=None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "split",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    plt = _import_matplotlib()
+    booster = _to_booster(model)
+    imp = booster.feature_importance(importance_type)
+    names = booster.feature_names or [f"Column_{i}" for i in range(len(imp))]
+    tuples = sorted(zip(names, imp), key=lambda t: t[1])
+    if ignore_zero:
+        tuples = [t for t in tuples if t[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    if not tuples:
+        raise ValueError("no features with importance > 0")
+    labels, values = zip(*tuples)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y, f"{x:.{precision}g}" if isinstance(x, float)
+                else str(x), va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(model, feature, bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature with "
+                                     "@feature@ @index/name@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid=True, **kwargs):
+    plt = _import_matplotlib()
+    booster = _to_booster(model)
+    if isinstance(feature, str):
+        feature = booster.feature_names.index(feature)
+    values = []
+    for t in booster.trees:
+        for i in range(t.num_nodes()):
+            if t.split_feature[i] == feature and not (t.decision_type[i] & 1):
+                values.append(t.threshold[i])
+    if not values:
+        raise ValueError(f"feature {feature} was not used in any split")
+    values = np.asarray(values)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    hist, edges = np.histogram(values, bins=bins or min(len(values), 20))
+    centers = (edges[:-1] + edges[1:]) / 2
+    ax.bar(centers, hist, width=width_coef * (edges[1] - edges[0]), **kwargs)
+    ax.set_title(title.replace("@feature@", "feature")
+                 .replace("@index/name@", str(feature)))
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster_or_record, metric: Optional[str] = None,
+                dataset_names=None, ax=None, xlim=None, ylim=None,
+                title="Metric during training", xlabel="Iterations",
+                ylabel="@metric@", figsize=None, dpi=None, grid=True):
+    plt = _import_matplotlib()
+    if isinstance(booster_or_record, dict):
+        record = booster_or_record
+    else:
+        raise TypeError("pass the dict from lgb.record_evaluation()")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(record.keys())
+    for name in names:
+        metrics = record[name]
+        mname = metric or next(iter(metrics))
+        ax.plot(metrics[mname], label=name)
+    ax.legend()
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel.replace("@metric@", metric or ""))
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(model, tree_index: int = 0, show_info=None,
+                        precision: int = 3, orientation: str = "horizontal",
+                        **kwargs):
+    """Graphviz Digraph of one tree (plotting.py create_tree_digraph)."""
+    try:
+        import graphviz
+    except ImportError as e:
+        raise ImportError("graphviz is required for tree plotting") from e
+    booster = _to_booster(model)
+    t = booster.trees[tree_index]
+    names = booster.feature_names
+    graph = graphviz.Digraph(**kwargs)
+    graph.attr(rankdir="LR" if orientation == "horizontal" else "TB")
+
+    def node_name(node):
+        return f"split{node}" if node >= 0 else f"leaf{~node}"
+
+    for i in range(t.num_nodes()):
+        fname = names[t.split_feature[i]] if names else str(t.split_feature[i])
+        if t.decision_type[i] & 1:
+            label = f"{fname} in set"
+        else:
+            label = f"{fname} <= {t.threshold[i]:.{precision}g}"
+        label += f"\\ngain: {t.split_gain[i]:.{precision}g}"
+        graph.node(node_name(i), label=label, shape="rectangle")
+        for child, tag in ((t.left_child[i], "yes"), (t.right_child[i], "no")):
+            graph.edge(node_name(i), node_name(child), label=tag)
+    for leaf in range(t.num_leaves):
+        graph.node(f"leaf{leaf}",
+                   label=f"leaf {leaf}: {t.leaf_value[leaf]:.{precision}g}\\n"
+                         f"count: {t.leaf_count[leaf]}",
+                   shape="ellipse")
+    return graph
+
+
+def plot_tree(model, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              **kwargs):
+    plt = _import_matplotlib()
+    graph = create_tree_digraph(model, tree_index=tree_index, **kwargs)
+    import io
+    try:
+        s = graph.pipe(format="png")
+    except Exception as e:
+        raise RuntimeError("graphviz executable required to render") from e
+    import matplotlib.image as mpimg
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    img = mpimg.imread(io.BytesIO(s))
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

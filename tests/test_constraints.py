@@ -1,0 +1,92 @@
+"""Monotone + interaction constraint tests
+(test_engine.py:1508-1670 monotone constraints analog, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _mono_data(n=4000, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 3)
+    # y increasing in x0, decreasing in x1, free in x2
+    y = (3.0 * x[:, 0] - 2.0 * x[:, 1] + np.sin(6.28 * x[:, 2])
+         + 0.2 * rs.randn(n)).astype(np.float32)
+    return x, y
+
+
+def _check_monotone(bst, feature, sign, n_checks=50, seed=1):
+    """Sweep the constrained feature on fixed rows; predictions must be
+    monotone in the swept direction."""
+    rs = np.random.RandomState(seed)
+    base = rs.rand(n_checks, 3)
+    grid = np.linspace(0.0, 1.0, 30)
+    ok = True
+    for i in range(n_checks):
+        rows = np.repeat(base[i][None, :], len(grid), axis=0)
+        rows[:, feature] = grid
+        pred = bst.predict(rows)
+        diffs = np.diff(pred)
+        if sign > 0:
+            ok &= bool((diffs >= -1e-9).all())
+        else:
+            ok &= bool((diffs <= 1e-9).all())
+    return ok
+
+
+class TestMonotone:
+    def test_increasing_decreasing(self):
+        x, y = _mono_data()
+        p = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+             "min_data_in_leaf": 10, "monotone_constraints": [1, -1, 0]}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=30)
+        assert _check_monotone(bst, 0, +1), "predictions not increasing in x0"
+        assert _check_monotone(bst, 1, -1), "predictions not decreasing in x1"
+        # still a useful model
+        mse = np.mean((bst.predict(x) - y) ** 2)
+        assert mse < 0.5 * np.var(y)
+
+    def test_unconstrained_violates(self):
+        # sanity: without constraints the sweep check fails (data is noisy)
+        x, y = _mono_data(seed=3)
+        p = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+             "min_data_in_leaf": 2}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=30)
+        assert not _check_monotone(bst, 2, +1)
+
+
+class TestInteraction:
+    def test_constraint_respected(self):
+        rs = np.random.RandomState(0)
+        n = 3000
+        x = rs.randn(n, 4)
+        y = (x[:, 0] * x[:, 1] + x[:, 2] + 0.1 * rs.randn(n)).astype(np.float32)
+        p = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5,
+             "interaction_constraints": "[0,1],[2,3]"}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        # every path may only mix features within one group
+        for t in bst.trees:
+            n_nodes = t.num_nodes()
+            if n_nodes == 0:
+                continue
+            # walk all root->node paths and collect features
+            def paths(node, feats):
+                if node < 0:
+                    yield feats
+                    return
+                nf = feats | {int(t.split_feature[node])}
+                yield from paths(t.left_child[node], nf)
+                yield from paths(t.right_child[node], nf)
+            for feats in paths(0, set()):
+                assert feats <= {0, 1} or feats <= {2, 3}, \
+                    f"path mixes groups: {feats}"
+
+    def test_feature_fraction_bynode(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "feature_fraction_bynode": 0.5}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        from lightgbm_tpu.metrics import _auc
+        assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9

@@ -14,7 +14,7 @@ import pytest
 from lightgbm_tpu.grower import make_grower
 from lightgbm_tpu.ops.split import SplitParams
 from lightgbm_tpu.parallel import (make_dp_grower, make_fp_grower, make_mesh,
-                                   shard_rows)
+                                   make_voting_grower, shard_rows)
 
 
 @pytest.fixture(scope="module")
@@ -92,6 +92,26 @@ class TestDataParallel:
         np.testing.assert_allclose(np.asarray(t_ser.leaf_value),
                                    np.asarray(t_dp.leaf_value),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestVotingParallel:
+    def test_quality_with_vote_compression(self, mesh8):
+        binned, vals = _data(n=4096, f=8)
+        F, B, L = binned.shape[1], 16, 8
+        p = SplitParams(min_data_in_leaf=5)
+        nb = jnp.full(F, B, jnp.int32)
+        na = jnp.full(F, -1, jnp.int32)
+        fm = jnp.ones(F, bool)
+        vp = make_voting_grower(mesh8, num_leaves=L, num_bins=B, params=p,
+                                top_k=2)
+        t = vp(shard_rows(mesh8, binned), shard_rows(mesh8, vals), fm, nb, na)
+        assert int(t.num_leaves) > 2
+        # informative feature must still be found despite vote compression
+        assert int(np.asarray(t.split_feature)[0]) == 2
+        bc = np.bincount(np.asarray(t.leaf_of_row),
+                         minlength=int(t.num_leaves))
+        np.testing.assert_allclose(bc[:int(t.num_leaves)],
+                                   np.asarray(t.leaf_count)[:int(t.num_leaves)])
 
 
 class TestFeatureParallel:
