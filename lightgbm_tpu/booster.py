@@ -191,9 +191,11 @@ class Booster:
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
-                **kw) -> np.ndarray:
+                pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0, **kw) -> np.ndarray:
         """Prediction on raw features (gbdt_prediction.cpp:97 inner loop,
-        Predictor analog)."""
+        Predictor analog).  ``pred_early_stop``: margin-based early exit
+        across trees (prediction_early_stop.cpp:91)."""
         from .dataset import _to_numpy_2d
         x, _, _ = _to_numpy_2d(data)
         n = len(x)
@@ -214,8 +216,22 @@ class Booster:
             return predict_contrib(self, x, t0, t1)
 
         score = np.zeros((n, k))
-        for ti in range(t0, t1):
-            score[:, ti % k] += self.tree_weights[ti] * self.trees[ti].predict(x)
+        active = np.ones(n, bool) if pred_early_stop else None
+        for it, ti in enumerate(range(t0, t1)):
+            if active is not None and not active.any():
+                break
+            rows = active if active is not None else slice(None)
+            score[rows, ti % k] += (self.tree_weights[ti]
+                                    * self.trees[ti].predict(
+                                        x[rows] if active is not None else x))
+            if active is not None and ti % k == k - 1 \
+                    and (it // k + 1) % pred_early_stop_freq == 0:
+                if k == 1:
+                    margin = np.abs(score[:, 0])
+                else:
+                    part = np.partition(score, -2, axis=1)
+                    margin = part[:, -1] - part[:, -2]
+                active &= margin < pred_early_stop_margin
         if self._average_output and t1 > t0:
             score /= (t1 - t0) // k
         if not raw_score and self.objective is not None:
@@ -320,6 +336,82 @@ class Booster:
         with open(filename, "w") as f:
             f.write(self.model_to_string(num_iteration, start_iteration))
         return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict[str, Any]:
+        """JSON model dump (GBDT::DumpModel, gbdt_model_text.cpp:21)."""
+        k = self._num_tree_per_iteration
+        t0 = start_iteration * k
+        t1 = len(self.trees) if num_iteration is None else \
+            min(t0 + num_iteration * k, len(self.trees))
+        names = self.feature_names or [f"Column_{i}"
+                                       for i in range(self._max_feature_idx + 1)]
+
+        def node_json(t: Tree, node: int) -> Dict[str, Any]:
+            if node < 0:
+                leaf = ~node
+                return {
+                    "leaf_index": int(leaf),
+                    "leaf_value": float(t.leaf_value[leaf]),
+                    "leaf_weight": float(t.leaf_weight[leaf]),
+                    "leaf_count": int(t.leaf_count[leaf]),
+                }
+            is_cat = bool(t.decision_type[node] & 1)
+            return {
+                "split_index": int(node),
+                "split_feature": int(t.split_feature[node]),
+                "split_gain": float(t.split_gain[node]),
+                "threshold": float(t.threshold[node]),
+                "decision_type": "==" if is_cat else "<=",
+                "default_left": bool(t.decision_type[node] & 2),
+                "missing_type": ["None", "Zero", "NaN"][
+                    (t.decision_type[node] >> 2) & 3],
+                "internal_value": float(t.internal_value[node]),
+                "internal_weight": float(t.internal_weight[node]),
+                "internal_count": int(t.internal_count[node]),
+                "left_child": node_json(t, t.left_child[node]),
+                "right_child": node_json(t, t.right_child[node]),
+            }
+
+        trees = []
+        for i, ti in enumerate(range(t0, t1)):
+            t = self.trees[ti]
+            trees.append({
+                "tree_index": i,
+                "num_leaves": int(t.num_leaves),
+                "num_cat": int(t.num_cat),
+                "shrinkage": float(t.shrinkage),
+                "tree_structure": node_json(t, 0 if t.num_leaves > 1 else -1),
+            })
+        return {
+            "name": "tree",
+            "version": "v3",
+            "num_class": self._num_class,
+            "num_tree_per_iteration": self._num_tree_per_iteration,
+            "label_index": 0,
+            "max_feature_idx": self._max_feature_idx,
+            "objective": getattr(self, "_objective_str", None) or
+                (_objective_to_string(self.config) if hasattr(self, "config")
+                 else "regression"),
+            "average_output": self._average_output,
+            "feature_names": names,
+            "feature_importances": {
+                names[f]: float(v)
+                for f, v in enumerate(self.feature_importance("gain")) if v > 0},
+            "tree_info": trees,
+        }
+
+    def refit(self, data, label, decay_rate: float = 0.9, **kw) -> "Booster":
+        """Refit existing tree structures on new data
+        (Booster.refit, basic.py / GBDT::RefitTree gbdt.cpp:287)."""
+        import copy as _copy
+        from .cli import refit as _refit
+        from .dataset import _to_numpy_2d
+        x, _, _ = _to_numpy_2d(data)
+        new_booster = Booster(model_str=self.model_to_string())
+        cfg = new_booster.config
+        cfg.refit_decay_rate = decay_rate
+        return _refit(new_booster, x, np.asarray(label, np.float32), cfg)
 
     # ------------------------------------------------------------------
     def _load_model_string(self, s: str) -> None:

@@ -66,6 +66,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_before.sort(key=lambda c: getattr(c, "order", 0))
     cbs_after.sort(key=lambda c: getattr(c, "order", 0))
 
+    import time as _time
+    t_start = _time.time()
     for i in range(num_boost_round):
         env = CallbackEnv(model=booster, params=params, iteration=i,
                           begin_iteration=0, end_iteration=num_boost_round,
@@ -73,6 +75,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         for cb in cbs_before:
             cb(env)
         stopped = booster.update(fobj=fobj)
+        if cfg.verbosity > 1:
+            from .utils.log import Log
+            Log.info(f"{_time.time() - t_start:.6f} seconds elapsed, "
+                     f"finished iteration {i + 1}")
+        if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
+            # periodic snapshot (gbdt.cpp:279-284 snapshot_freq)
+            booster.save_model(f"{cfg.output_model}.snapshot_iter_{i + 1}")
         evals = []
         if booster._valid_names or cfg.is_provide_training_metric:
             if cfg.is_provide_training_metric:

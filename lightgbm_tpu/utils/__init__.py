@@ -1,0 +1,2 @@
+from .log import Log, register_log_callback
+from .timer import FunctionTimer, global_timer

@@ -1,0 +1,56 @@
+"""Logging (reference: include/LightGBM/utils/log.h:71-177).
+
+Level-filtered logger with a pluggable sink callback
+(``LGBM_RegisterLogCallback`` analog, c_api.h:71) — the python-package
+redirects to the ``logging`` module (basic.py:49-110), which is the default
+sink here.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Callable, Optional
+
+_logger = logging.getLogger("lightgbm_tpu")
+_callback: Optional[Callable[[str], None]] = None
+
+
+class Log:
+    """Log::Debug/Info/Warning/Fatal (log.h)."""
+    level: int = 1  # -1 fatal only, 0 +warning, 1 +info, 2 +debug
+
+    @staticmethod
+    def _emit(msg: str, py_level: int) -> None:
+        if _callback is not None:
+            _callback(msg + "\n")
+        else:
+            _logger.log(py_level, msg)
+            if not _logger.handlers and not logging.getLogger().handlers:
+                print(msg, file=sys.stderr)
+
+    @classmethod
+    def debug(cls, msg: str) -> None:
+        if cls.level >= 2:
+            cls._emit(f"[LightGBM-TPU] [Debug] {msg}", logging.DEBUG)
+
+    @classmethod
+    def info(cls, msg: str) -> None:
+        if cls.level >= 1:
+            cls._emit(f"[LightGBM-TPU] [Info] {msg}", logging.INFO)
+
+    @classmethod
+    def warning(cls, msg: str) -> None:
+        if cls.level >= 0:
+            cls._emit(f"[LightGBM-TPU] [Warning] {msg}", logging.WARNING)
+
+    @classmethod
+    def fatal(cls, msg: str) -> None:
+        cls._emit(f"[LightGBM-TPU] [Fatal] {msg}", logging.ERROR)
+        raise RuntimeError(msg)
+
+
+def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
+    """LGBM_RegisterLogCallback analog."""
+    global _callback
+    _callback = cb

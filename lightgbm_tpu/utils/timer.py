@@ -1,0 +1,70 @@
+"""Tracing / profiling (reference: include/LightGBM/utils/common.h:978-1056).
+
+``FunctionTimer`` RAII scopes accumulating into a ``global_timer`` registry
+printed at exit (``Timer::Print``), plus integration with ``jax.profiler``
+traces: when profiling is enabled the same scopes emit
+``jax.profiler.TraceAnnotation`` ranges so device timelines carry the
+reference's phase names (SURVEY.md §5 tracing mapping).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import time
+from typing import Dict, Optional
+
+
+class Timer:
+    """Accumulating named-scope timer (Common::Timer analog)."""
+
+    def __init__(self):
+        self.stats: Dict[str, float] = collections.defaultdict(float)
+        self.counts: Dict[str, int] = collections.defaultdict(int)
+        self.enabled = False
+
+    def start(self, name: str) -> float:
+        return time.perf_counter()
+
+    def stop(self, name: str, t0: float) -> None:
+        self.stats[name] += time.perf_counter() - t0
+        self.counts[name] += 1
+
+    def print_summary(self) -> None:
+        if not self.enabled or not self.stats:
+            return
+        print("LightGBM-TPU timers:")
+        for name, total in sorted(self.stats.items(), key=lambda kv: -kv[1]):
+            print(f"  {name}: {total:.3f}s ({self.counts[name]} calls)")
+
+
+global_timer = Timer()
+atexit.register(global_timer.print_summary)
+
+
+class FunctionTimer:
+    """RAII/context scope (Common::FunctionTimer analog); doubles as a
+    jax.profiler trace annotation for device timelines."""
+
+    def __init__(self, name: str, timer: Optional[Timer] = None):
+        self.name = name
+        self.timer = timer or global_timer
+        self._t0 = 0.0
+        self._annotation = None
+
+    def __enter__(self):
+        self._t0 = self.timer.start(self.name)
+        if self.timer.enabled:
+            try:
+                import jax.profiler
+                self._annotation = jax.profiler.TraceAnnotation(self.name)
+                self._annotation.__enter__()
+            except Exception:
+                self._annotation = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+        self.timer.stop(self.name, self._t0)
+        return False

@@ -1,0 +1,84 @@
+"""Aux subsystem tests: logging, timers, dump_model, refit, pred early stop
+(test_utilities.py / SURVEY.md §5 analog)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils import FunctionTimer, Log, global_timer, \
+    register_log_callback
+
+
+class TestLog:
+    def test_callback_sink(self):
+        msgs = []
+        register_log_callback(lambda m: msgs.append(m))
+        try:
+            Log.info("hello")
+            Log.warning("warn")
+            assert any("hello" in m for m in msgs)
+            assert any("warn" in m for m in msgs)
+        finally:
+            register_log_callback(None)
+
+    def test_fatal_raises(self):
+        with pytest.raises(RuntimeError):
+            Log.fatal("boom")
+
+
+class TestTimer:
+    def test_scopes_accumulate(self):
+        with FunctionTimer("unit_test_scope"):
+            pass
+        assert global_timer.counts["unit_test_scope"] >= 1
+
+
+class TestDumpModel:
+    def test_json_dump(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=3)
+        d = bst.dump_model()
+        s = json.dumps(d)  # must be JSON-serializable
+        assert d["num_class"] == 1
+        assert len(d["tree_info"]) == 3
+        t0 = d["tree_info"][0]["tree_structure"]
+        assert "split_feature" in t0
+        assert "left_child" in t0
+
+    def test_pred_early_stop(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=30)
+        full = bst.predict(x[:200], raw_score=True)
+        es = bst.predict(x[:200], raw_score=True, pred_early_stop=True,
+                         pred_early_stop_freq=5, pred_early_stop_margin=2.0)
+        # early-stopped rows keep the same SIGN (classification unchanged)
+        assert ((full > 0) == (es > 0)).mean() > 0.98
+
+
+class TestRefit:
+    def test_refit_api(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=5)
+        refitted = bst.refit(x, y, decay_rate=0.5)
+        assert refitted.num_trees() == bst.num_trees()
+        from lightgbm_tpu.metrics import _auc
+        assert _auc(y, refitted.predict(x, raw_score=True), None) > 0.9
+
+
+class TestSnapshot:
+    def test_snapshot_freq(self, binary_data, tmp_path):
+        x, y = binary_data
+        out = str(tmp_path / "m.txt")
+        p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+             "snapshot_freq": 2, "output_model": out}
+        lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=4)
+        import os
+        assert os.path.exists(out + ".snapshot_iter_2")
+        assert os.path.exists(out + ".snapshot_iter_4")
+        snap = lgb.Booster(model_file=out + ".snapshot_iter_2")
+        assert snap.num_trees() == 2
