@@ -116,6 +116,37 @@ def _pull(res: SplitResult) -> _HostSplit:
         is_cat=bool(res.is_cat), bin_rank=np.asarray(res.bin_rank))
 
 
+class CEGBState(NamedTuple):
+    """Cost-effective gradient boosting penalties
+    (cost_effective_gradient_boosting.hpp:22-160): per-split data-acquisition
+    cost + per-feature coupled (once per model) and lazy (per data point,
+    approximated here by leaf size) penalties, scaled by cegb_tradeoff and
+    subtracted from candidate gains.  ``used`` persists across trees."""
+    tradeoff: float
+    penalty_split: float
+    coupled: Optional[np.ndarray]     # [F] or None
+    lazy: Optional[np.ndarray]        # [F] or None
+    used: np.ndarray                  # [F] bool, mutated in place
+
+    def penalty_vector(self, num_data_in_leaf: float) -> np.ndarray:
+        f = len(self.used)
+        pen = np.full(f, self.tradeoff * self.penalty_split
+                      * float(num_data_in_leaf), np.float32)
+        if self.coupled is not None:
+            pen += self.tradeoff * self.coupled * (~self.used)
+        if self.lazy is not None:
+            pen += self.tradeoff * self.lazy * float(num_data_in_leaf)
+        return pen
+
+    def mark_used(self, feature: int) -> None:
+        self.used[feature] = True
+
+    @property
+    def active(self) -> bool:
+        return (self.penalty_split > 0 or self.coupled is not None
+                or self.lazy is not None)
+
+
 class PartitionedGrower:
     """Host-orchestrated device-resident leaf-wise learner.
 
@@ -146,7 +177,8 @@ class PartitionedGrower:
         self._find = jax.jit(functools.partial(find_best_split, params=params))
 
     def grow(self, binned, vals, feature_mask, num_bin, na_bin,
-             is_cat=None) -> TreeArrays:
+             is_cat=None, forced=None,
+             cegb_state: Optional[CEGBState] = None) -> TreeArrays:
         L, B = self.L, self.B
         n, f = binned.shape
         p_full = _pow2(n)
@@ -182,6 +214,9 @@ class PartitionedGrower:
                 kw = dict(mono=self.mono,
                           out_lo=jnp.float32(leaf_lo[leaf]),
                           out_hi=jnp.float32(leaf_hi[leaf]))
+            if cegb_state is not None and cegb_state.active:
+                kw["gain_penalty"] = jnp.asarray(
+                    cegb_state.penalty_vector(total[2]))
             return self._find(hist, jnp.asarray(total, jnp.float32),
                               num_bin, na_bin, _node_mask(leaf_mask[leaf]),
                               parent_output=jnp.float32(pout),
@@ -218,15 +253,11 @@ class PartitionedGrower:
         leaf_count[0] = total0[2]
 
         num_leaves = 1
-        for i in range(L - 1):
-            # pick best leaf (host argmax — the per-leaf candidates are here)
-            ok = [l for l in range(num_leaves)
-                  if cand[l].gain > 0
-                  and (self.max_depth <= 0 or depth[l] < self.max_depth)]
-            if not ok:
-                break
-            leaf = max(ok, key=lambda l: cand[l].gain)
-            rec = cand[leaf]
+        order_box = [order]
+
+        def apply_split(i: int, leaf: int, rec: _HostSplit) -> None:
+            nonlocal num_leaves
+            order = order_box[0]
             new = num_leaves
 
             # tree bookkeeping (Tree::Split)
@@ -316,8 +347,41 @@ class PartitionedGrower:
             cand[leaf] = _pull(r_l)
             cand[new] = _pull(r_r)
             num_leaves = new + 1
-            del cl_dev
+            order_box[0] = order
 
+        # forced splits pre-pass (ForceSplits, serial_tree_learner.cpp:455):
+        # apply the forced tree top regardless of gain, in BFS order
+        node_budget = L - 1
+        next_node = 0
+        if forced is not None:
+            queue = [(forced, 0)]
+            while queue and next_node < node_budget:
+                spec, leaf = queue.pop(0)
+                rec = self._forced_record(spec, hists[leaf], totals[leaf],
+                                          parent_out[leaf], B)
+                if rec is None:
+                    continue
+                new = num_leaves
+                apply_split(next_node, leaf, rec)
+                next_node += 1
+                if isinstance(spec.get("left"), dict):
+                    queue.append((spec["left"], leaf))
+                if isinstance(spec.get("right"), dict):
+                    queue.append((spec["right"], new))
+
+        for i in range(next_node, L - 1):
+            # pick best leaf (host argmax — the per-leaf candidates are here)
+            ok = [l for l in range(num_leaves)
+                  if cand[l].gain > 0
+                  and (self.max_depth <= 0 or depth[l] < self.max_depth)]
+            if not ok:
+                break
+            leaf = max(ok, key=lambda l: cand[l].gain)
+            if cegb_state is not None:
+                cegb_state.mark_used(cand[leaf].feature)
+            apply_split(i, leaf, cand[leaf])
+
+        order = order_box[0]
         # reconstruct leaf_of_row from segments
         seg = sorted(((begins[l], l) for l in range(num_leaves)))
         seg_begins = jnp.asarray([s[0] for s in seg], jnp.int32)
@@ -344,6 +408,34 @@ class PartitionedGrower:
             cat_rank=jnp.asarray(cat_rank),
         )
 
+    def _forced_record(self, spec, hist, total, pout, B) -> Optional[_HostSplit]:
+        """Build a split record for a forced (feature, threshold) node
+        (forcedsplits_filename, serial_tree_learner.cpp ForceSplits)."""
+        f = int(spec["feature"])
+        t = int(spec["threshold_bin"])
+        h = np.asarray(hist[f])                         # [B, 3]
+        lsum = h[:t + 1].sum(axis=0)
+        rsum = np.asarray(total, np.float64) - lsum
+        if lsum[2] < 1 or rsum[2] < 1:
+            return None
+        p = self.params
+
+        def out(s):
+            g, hh = float(s[0]), float(s[1])
+            tl1 = np.sign(g) * max(0.0, abs(g) - p.lambda_l1) \
+                if p.lambda_l1 > 0 else g
+            o = -tl1 / (hh + p.lambda_l2 + 1e-15)
+            if p.max_delta_step > 0:
+                o = float(np.clip(o, -p.max_delta_step, p.max_delta_step))
+            return float(o)
+
+        return _HostSplit(
+            gain=0.0, feature=f, threshold=t, default_left=False,
+            left_sum=lsum.astype(np.float32), right_sum=rsum.astype(np.float32),
+            left_output=out(lsum), right_output=out(rsum),
+            is_cat=False, bin_rank=np.arange(B, dtype=np.int32))
+
     def __call__(self, binned, vals, feature_mask, num_bin, na_bin,
-                 is_cat=None):
-        return self.grow(binned, vals, feature_mask, num_bin, na_bin, is_cat)
+                 is_cat=None, **kw):
+        return self.grow(binned, vals, feature_mask, num_bin, na_bin,
+                         is_cat, **kw)

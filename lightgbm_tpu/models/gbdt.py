@@ -110,8 +110,11 @@ class GBDTModel:
             mc_full[:len(mc_in)] = mc_in
             mono = mc_full[np.asarray(ds.used_features)]
         inter = self._interaction_allow(config, ds)
+        self._cegb_state = self._make_cegb(config, ds)
+        self._forced_spec = self._load_forced(config, ds)
         has_node_controls = (mono is not None and np.any(mono)) \
-            or inter is not None or config.feature_fraction_bynode < 1.0
+            or inter is not None or config.feature_fraction_bynode < 1.0 \
+            or self._cegb_state is not None or self._forced_spec is not None
 
         if hist_reduce is None and config.tpu_learner == "partitioned":
             # single-chip performance learner (grower_partitioned.py):
@@ -158,6 +161,60 @@ class GBDTModel:
         self._bag_mask: Optional[np.ndarray] = None
         self._goss = config.data_sample_strategy == "goss"
         self._last_iter_state: Optional[dict] = None
+
+    @staticmethod
+    def _make_cegb(config: Config, ds: Dataset):
+        """CEGB penalties over used-feature slots
+        (cost_effective_gradient_boosting.hpp)."""
+        coupled_in = config.cegb_penalty_feature_coupled
+        lazy_in = config.cegb_penalty_feature_lazy
+        if config.cegb_penalty_split <= 0 and not coupled_in and not lazy_in:
+            return None
+        from ..grower_partitioned import CEGBState
+        nf = len(ds.used_features)
+
+        def slot_array(vals):
+            if not vals:
+                return None
+            full = np.zeros(ds.num_total_features, np.float32)
+            full[:len(vals)] = np.asarray(vals, np.float32)
+            return full[np.asarray(ds.used_features)]
+
+        return CEGBState(
+            tradeoff=config.cegb_tradeoff,
+            penalty_split=config.cegb_penalty_split,
+            coupled=slot_array(coupled_in),
+            lazy=slot_array(lazy_in),
+            used=np.zeros(nf, bool))
+
+    @staticmethod
+    def _load_forced(config: Config, ds: Dataset):
+        """Parse forcedsplits_filename JSON into slot/bin space
+        (forced splits file, serial_tree_learner.cpp:455)."""
+        if not config.forcedsplits_filename:
+            return None
+        import json
+        with open(config.forcedsplits_filename) as f:
+            spec = json.load(f)
+        slot_of_orig = {f: i for i, f in enumerate(ds.used_features)}
+
+        def conv(node):
+            if not isinstance(node, dict) or "feature" not in node:
+                return None
+            orig = int(node["feature"])
+            if orig not in slot_of_orig:
+                return None
+            mapper = ds.bin_mappers[orig]
+            thr_bin = int(mapper.value_to_bin(
+                np.asarray([float(node["threshold"])]))[0])
+            out = {"feature": slot_of_orig[orig], "threshold_bin": thr_bin}
+            for side in ("left", "right"):
+                c = conv(node.get(side))
+                if c is not None:
+                    out[side] = c
+            return out
+
+        return conv(spec)
 
     @staticmethod
     def _interaction_allow(config: Config, ds: Dataset):
@@ -311,13 +368,17 @@ class GBDTModel:
             else:
                 w = jnp.ones(self.num_data, jnp.float32)
             vals = jnp.stack([g * w, h * w, w], axis=1)
+            gkw = {}
             if self.is_cat_dev is not None:
-                arrays = self.grower(self.binned_dev, vals, fmask,
-                                     self.num_bin_dev, self.na_bin_dev,
-                                     is_cat=self.is_cat_dev)
-            else:
-                arrays = self.grower(self.binned_dev, vals, fmask,
-                                     self.num_bin_dev, self.na_bin_dev)
+                gkw["is_cat"] = self.is_cat_dev
+            from ..grower_partitioned import PartitionedGrower
+            if isinstance(self.grower, PartitionedGrower):
+                if self._forced_spec is not None:
+                    gkw["forced"] = self._forced_spec
+                if self._cegb_state is not None:
+                    gkw["cegb_state"] = self._cegb_state
+            arrays = self.grower(self.binned_dev, vals, fmask,
+                                 self.num_bin_dev, self.na_bin_dev, **gkw)
             nl = int(arrays.num_leaves)
             leaf_values = np.asarray(arrays.leaf_value, np.float64).copy()
             if nl <= 1:

@@ -244,8 +244,8 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
                     na_bin: jax.Array, feature_mask: jax.Array,
                     params: SplitParams, parent_output: jax.Array = None,
                     is_cat: jax.Array = None, mono: jax.Array = None,
-                    out_lo: jax.Array = None, out_hi: jax.Array = None
-                    ) -> SplitResult:
+                    out_lo: jax.Array = None, out_hi: jax.Array = None,
+                    gain_penalty: jax.Array = None) -> SplitResult:
     """Best split for one leaf across numerical and categorical features.
 
     hist:         [F, B, 3] f32 — per-feature histograms (g, h, count)
@@ -267,6 +267,13 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
     if mono is not None:
         ngains = _monotone_adjust(ngains, nlefts, total, mono, out_lo, out_hi,
                                   0, params, parent_out)
+    if gain_penalty is not None:
+        # CEGB per-feature acquisition penalty subtracted from candidate
+        # gains (cost_effective_gradient_boosting.hpp:70-78 DeltaGain)
+        pen = gain_penalty[None, :, None]
+        ngains = jnp.where(ngains > kMinScore,
+                           jnp.where(ngains - pen > kEpsilon,
+                                     ngains - pen, kMinScore), ngains)
     nflat = ngains.reshape(-1)
     nbest = jnp.argmax(nflat)
     nbest_gain = nflat[nbest]
@@ -275,6 +282,11 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
         cat_mask = feature_mask & is_cat
         cgains, clefts, corders = _categorical_candidates(
             hist, total, num_bin, cat_mask, params, parent_out)
+        if gain_penalty is not None:
+            cpen = gain_penalty[None, :, None]
+            cgains = jnp.where(cgains > kMinScore,
+                               jnp.where(cgains - cpen > kEpsilon,
+                                         cgains - cpen, kMinScore), cgains)
         cflat = cgains.reshape(-1)
         cbest = jnp.argmax(cflat)
         cbest_gain = cflat[cbest]

@@ -1,0 +1,55 @@
+"""CEGB + forced-splits tests (test_engine.py forced_splits / cegb analog)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+class TestCEGB:
+    def test_coupled_penalty_discourages_feature(self, binary_data):
+        x, y = binary_data
+        base = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+                "min_data_in_leaf": 5}
+        bst0 = lgb.train(base, lgb.Dataset(x, label=y), num_boost_round=10)
+        imp0 = bst0.feature_importance("split")
+        top = int(np.argmax(imp0))
+        # huge coupled penalty on the top feature bans it
+        penalties = [0.0] * x.shape[1]
+        penalties[top] = 1e9
+        p = dict(base, cegb_tradeoff=1.0,
+                 cegb_penalty_feature_coupled=penalties)
+        bst1 = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        imp1 = bst1.feature_importance("split")
+        assert imp1[top] == 0
+
+    def test_split_penalty_prunes(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+             "min_data_in_leaf": 5, "cegb_tradeoff": 1.0,
+             "cegb_penalty_split": 1e9}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=3)
+        # penalty so large no split is worth it -> stump trees
+        assert all(t.num_leaves == 1 for t in bst.trees)
+
+
+class TestForcedSplits:
+    def test_forced_top(self, binary_data, tmp_path):
+        x, y = binary_data
+        forced = {"feature": 5, "threshold": 0.0,
+                  "left": {"feature": 6, "threshold": 0.5}}
+        path = str(tmp_path / "forced.json")
+        with open(path, "w") as f:
+            json.dump(forced, f)
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "forcedsplits_filename": path}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=5)
+        for t in bst.trees:
+            assert t.split_feature[0] == 5          # forced root
+            # node 1 (left child of root) forced to feature 6
+            if t.num_nodes() > 1 and t.left_child[0] == 1:
+                assert t.split_feature[1] == 6
+        from lightgbm_tpu.metrics import _auc
+        assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9
