@@ -139,6 +139,9 @@ class GBDTModel:
                 params=self.split_params, max_depth=config.max_depth,
                 block_rows=config.rows_per_block, hist_reduce=hist_reduce)
 
+        if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
+            raise ValueError("linear_tree requires boosting=gbdt")
+
         if self.objective is not None:
             self.objective.init(ds.metadata, self.num_data)
 
@@ -161,6 +164,84 @@ class GBDTModel:
         self._bag_mask: Optional[np.ndarray] = None
         self._goss = config.data_sample_strategy == "goss"
         self._last_iter_state: Optional[dict] = None
+
+    def _fit_linear_leaves(self, arrays: TreeArrays, ht: Tree, g, h, w,
+                           shrinkage: float, bias: float) -> None:
+        """Per-leaf linear models (LinearTreeLearner::CalculateLinear,
+        linear_tree_learner.cpp): Newton-step ridge regression of the
+        gradients on the leaf's path features; coefficients shrunk by the
+        learning rate; constant = fitted intercept (+ iteration-0 bias)."""
+        nl = int(arrays.num_leaves)
+        raw = self.train_set.raw_data
+        if nl <= 1 or raw is None:
+            return
+        lc = np.asarray(arrays.left_child)[:nl - 1]
+        rc = np.asarray(arrays.right_child)[:nl - 1]
+        sf = np.asarray(arrays.split_feature)[:nl - 1]
+        icn = np.asarray(arrays.is_cat_node)[:nl - 1]
+        lor = np.asarray(arrays.leaf_of_row)
+        used = self.train_set.used_features
+
+        paths: Dict[int, List[int]] = {}
+        stack = [(0, [])]
+        while stack:
+            node, feats = stack.pop()
+            if node < 0:
+                paths[~node] = feats
+                continue
+            nf = feats if icn[node] else feats + [int(used[sf[node]])]
+            stack.append((int(lc[node]), nf))
+            stack.append((int(rc[node]), nf))
+
+        g_np = np.asarray(g, np.float64)
+        h_np = np.asarray(h, np.float64)
+        w_np = np.asarray(w, np.float64)
+        lam = self.config.linear_lambda
+        ht.is_linear = True
+        for leaf in range(nl):
+            feats = list(dict.fromkeys(paths.get(leaf, [])))
+            rows = np.nonzero((lor == leaf) & (w_np > 0))[0]
+            ht.leaf_const[leaf] = ht.leaf_value[leaf]
+            ht.leaf_features[leaf], ht.leaf_coeff[leaf] = [], []
+            if not feats or len(rows) < len(feats) + 2:
+                continue
+            X = raw[np.ix_(rows, feats)].astype(np.float64)
+            ok = ~np.isnan(X).any(axis=1)
+            if ok.sum() < len(feats) + 2:
+                continue
+            X, gg, hh = X[ok], g_np[rows][ok], h_np[rows][ok]
+            Xt = np.column_stack([X, np.ones(len(X))])
+            A = Xt.T @ (hh[:, None] * Xt)
+            A[np.arange(len(feats)), np.arange(len(feats))] += lam
+            A[np.arange(len(A)), np.arange(len(A))] += 1e-10
+            b = -Xt.T @ gg
+            try:
+                beta = np.linalg.solve(A, b)
+            except np.linalg.LinAlgError:
+                continue
+            if not np.isfinite(beta).all():
+                continue
+            ht.leaf_features[leaf] = feats
+            ht.leaf_coeff[leaf] = (beta[:-1] * shrinkage).tolist()
+            ht.leaf_const[leaf] = float(beta[-1] * shrinkage) + bias
+
+    @staticmethod
+    def _linear_outputs(ht: Tree, leaves: np.ndarray,
+                        raw: np.ndarray) -> np.ndarray:
+        """Per-row outputs of a linear tree given row->leaf assignment."""
+        out = ht.leaf_value[leaves].astype(np.float64)
+        for leaf in range(ht.num_leaves):
+            feats = ht.leaf_features[leaf]
+            if not feats:
+                continue
+            m = leaves == leaf
+            if not m.any():
+                continue
+            sub = raw[np.ix_(m, feats)].astype(np.float64)
+            val = ht.leaf_const[leaf] + sub @ np.asarray(ht.leaf_coeff[leaf])
+            out[m] = np.where(np.isnan(sub).any(axis=1), ht.leaf_value[leaf],
+                              val)
+        return out
 
     @staticmethod
     def _make_cegb(config: Config, ds: Dataset):
@@ -257,9 +338,20 @@ class GBDTModel:
         # replay existing trees (continued training)
         for ti, dt in enumerate(self.device_trees):
             k = ti % self.num_class
-            score = score.at[:, k].set(_apply_tree(
-                score[:, k], binned, dt, self.na_bin_dev,
-                self.tree_weights[ti]))
+            ht = self.models[ti] if ti < len(self.models) else None
+            if ht is not None and ht.is_linear:
+                leaves = np.asarray(traverse_tree_binned(
+                    binned, dt.split_feature, dt.threshold_bin,
+                    dt.default_left, dt.left_child, dt.right_child,
+                    self.na_bin_dev, dt.is_cat_node, dt.cat_rank,
+                    steps=dt.steps))
+                delta = self._linear_outputs(ht, leaves, valid.raw_data)
+                score = score.at[:, k].add(
+                    self.tree_weights[ti] * jnp.asarray(delta, jnp.float32))
+            else:
+                score = score.at[:, k].set(_apply_tree(
+                    score[:, k], binned, dt, self.na_bin_dev,
+                    self.tree_weights[ti]))
         self.valid_sets.append((valid, binned, score))
 
     # -- sampling (gbdt.cpp:230 Bagging + goss.hpp) ------------------------
@@ -405,15 +497,31 @@ class GBDTModel:
             # host tree
             ht = Tree.from_arrays(arrays, self.train_set.used_features,
                                   self.train_set.bin_mappers)
-            ht.leaf_value = host_values[:max(nl, 1)].copy()
             ht.internal_value = ht.internal_value * shrinkage
             ht.shrinkage = shrinkage
             iter_trees.append(ht)
 
-            # score update via row->leaf gather (no traversal needed)
-            lv_dev = jnp.asarray(dev_values, jnp.float32)
-            delta = jnp.take(lv_dev, arrays.leaf_of_row)
-            self.score = self.score.at[:, k].add(delta)
+            linear = cfg.linear_tree and nl > 1
+            if linear:
+                # fit per-leaf linear models on bias-free leaf values, then
+                # fold the init bias in afterwards (score already has it)
+                ht.leaf_value = leaf_values[:max(nl, 1)].copy()
+                self._fit_linear_leaves(arrays, ht, g, h, w, shrinkage, 0.0)
+                lor_np = np.asarray(arrays.leaf_of_row)
+                delta_np = self._linear_outputs(ht, lor_np,
+                                                self.train_set.raw_data)
+                self.score = self.score.at[:, k].add(
+                    jnp.asarray(delta_np, jnp.float32))
+                if init_scores[k] != 0.0:
+                    ht.leaf_value += init_scores[k]
+                    ht.leaf_const += init_scores[k]
+                lv_dev = jnp.asarray(dev_values, jnp.float32)
+            else:
+                ht.leaf_value = host_values[:max(nl, 1)].copy()
+                # score update via row->leaf gather (no traversal needed)
+                lv_dev = jnp.asarray(dev_values, jnp.float32)
+                delta = jnp.take(lv_dev, arrays.leaf_of_row)
+                self.score = self.score.at[:, k].add(delta)
 
             steps = round_up_pow2(max(ht.max_depth(), 1))
             dt = _DeviceTree(arrays, dev_values, steps)
@@ -425,7 +533,18 @@ class GBDTModel:
 
             # validation score updates
             for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
-                ns = _apply_tree(vscore[:, k], vbinned, dt, self.na_bin_dev, 1.0)
+                if linear:
+                    vleaves = np.asarray(traverse_tree_binned(
+                        vbinned, dt.split_feature, dt.threshold_bin,
+                        dt.default_left, dt.left_child, dt.right_child,
+                        self.na_bin_dev, dt.is_cat_node, dt.cat_rank,
+                        steps=dt.steps))
+                    vdelta = self._linear_outputs(ht, vleaves, vds.raw_data) \
+                        - (init_scores[k] if init_scores[k] != 0.0 else 0.0)
+                    ns = vscore[:, k] + jnp.asarray(vdelta, jnp.float32)
+                else:
+                    ns = _apply_tree(vscore[:, k], vbinned, dt,
+                                     self.na_bin_dev, 1.0)
                 self.valid_sets[vi] = (vds, vbinned, vscore.at[:, k].set(ns))
 
         self.models.extend(iter_trees)

@@ -48,7 +48,12 @@ class Tree:
         self.cat_boundaries = [0]
         self.cat_threshold: List[int] = []             # packed uint32 bitset words
         self.shrinkage = 1.0
+        # linear trees (LinearTreeLearner, linear_tree_learner.cpp): per-leaf
+        # linear model out = leaf_const + sum(leaf_coeff * x[leaf_features])
         self.is_linear = False
+        self.leaf_const = np.zeros(num_leaves, np.float64)
+        self.leaf_features: List[List[int]] = [[] for _ in range(num_leaves)]
+        self.leaf_coeff: List[List[float]] = [[] for _ in range(num_leaves)]
 
     # ------------------------------------------------------------------
     @classmethod
@@ -186,7 +191,26 @@ class Tree:
         return go_left
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return self.leaf_value[self.predict_leaf(X)]
+        leaves = self.predict_leaf(X)
+        if not self.is_linear:
+            return self.leaf_value[leaves]
+        # linear leaves: const + coeffs; rows with NaN in used features fall
+        # back to the constant leaf_value (linear_tree_learner.cpp nan path)
+        out = np.zeros(len(X), np.float64)
+        for leaf in range(self.num_leaves):
+            m = leaves == leaf
+            if not m.any():
+                continue
+            feats = self.leaf_features[leaf]
+            if not feats:
+                out[m] = self.leaf_value[leaf]
+                continue
+            sub = X[np.ix_(m, feats)].astype(np.float64)
+            val = self.leaf_const[leaf] + sub @ np.asarray(self.leaf_coeff[leaf])
+            nan_rows = np.isnan(sub).any(axis=1)
+            val = np.where(nan_rows, self.leaf_value[leaf], val)
+            out[m] = val
+        return out
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Vectorized level-by-level traversal over raw features."""
@@ -235,6 +259,17 @@ class Tree:
             lines.append(f"cat_boundaries={fmt(self.cat_boundaries, '%d')}")
             lines.append(f"cat_threshold={fmt(self.cat_threshold, '%d')}")
         lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # linear-tree block (gbdt_model_text per-leaf linear model lines)
+            lines.append(f"leaf_const={fmt(self.leaf_const, '%.17g')}")
+            lines.append("num_features=" + " ".join(
+                str(len(f_)) for f_ in self.leaf_features))
+            lines.append("leaf_features=" + " ".join(
+                " ".join(str(int(v)) for v in f_) for f_ in self.leaf_features
+                if len(f_)))
+            lines.append("leaf_coeff=" + " ".join(
+                " ".join(f"{v:.17g}" for v in c_) for c_ in self.leaf_coeff
+                if len(c_)))
         lines.append(f"shrinkage={self.shrinkage:g}")
         lines.append("")
         return "\n".join(lines)
@@ -273,4 +308,21 @@ class Tree:
             t.cat_threshold = [int(x) for x in kv["cat_threshold"].split(" ")]
         t.shrinkage = float(kv.get("shrinkage", "1"))
         t.is_linear = bool(int(kv.get("is_linear", "0")))
+        if t.is_linear and "leaf_const" in kv:
+            t.leaf_const = arr("leaf_const", np.float64, nl)
+            nfeat = [int(v) for v in kv.get("num_features", "").split(" ")
+                     if v != ""]
+            flat_f = [int(v) for v in kv.get("leaf_features", "").split(" ")
+                      if v != ""]
+            flat_c = [float(v) for v in kv.get("leaf_coeff", "").split(" ")
+                      if v != ""]
+            t.leaf_features, t.leaf_coeff = [], []
+            pos = 0
+            for cnt in nfeat:
+                t.leaf_features.append(flat_f[pos:pos + cnt])
+                t.leaf_coeff.append(flat_c[pos:pos + cnt])
+                pos += cnt
+            while len(t.leaf_features) < nl:
+                t.leaf_features.append([])
+                t.leaf_coeff.append([])
         return t

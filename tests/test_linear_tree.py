@@ -1,0 +1,63 @@
+"""Linear-tree tests (test_engine.py linear trees analog)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+class TestLinearTree:
+    def test_beats_constant_leaves_on_linear_data(self):
+        rs = np.random.RandomState(0)
+        n = 3000
+        x = rs.randn(n, 4)
+        y = (2.0 * x[:, 0] + 1.0 * x[:, 1] + 0.05 * rs.randn(n)) \
+            .astype(np.float32)
+        base = {"objective": "regression", "num_leaves": 7, "max_bin": 31,
+                "min_data_in_leaf": 20}
+        bst_const = lgb.train(base, lgb.Dataset(x, label=y),
+                              num_boost_round=40)
+        bst_lin = lgb.train(dict(base, linear_tree=True),
+                            lgb.Dataset(x, label=y), num_boost_round=40)
+        mse_const = float(np.mean((bst_const.predict(x) - y) ** 2))
+        mse_lin = float(np.mean((bst_lin.predict(x) - y) ** 2))
+        assert mse_lin < 0.5 * mse_const, (mse_lin, mse_const)
+
+    def test_model_roundtrip(self, tmp_path):
+        rs = np.random.RandomState(1)
+        x = rs.randn(1000, 3)
+        y = (x[:, 0] + 0.5 * x[:, 1]).astype(np.float32)
+        p = {"objective": "regression", "num_leaves": 5, "max_bin": 31,
+             "linear_tree": True}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=3)
+        path = str(tmp_path / "lin.txt")
+        bst.save_model(path)
+        assert "is_linear=1" in open(path).read()
+        bst2 = lgb.Booster(model_file=path)
+        np.testing.assert_allclose(bst.predict(x[:100]), bst2.predict(x[:100]),
+                                   rtol=1e-5, atol=1e-8)
+
+    def test_nan_rows_fall_back_to_constant(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(1500, 3)
+        y = (x[:, 0] * 1.5).astype(np.float32)
+        p = {"objective": "regression", "num_leaves": 5, "max_bin": 31,
+             "linear_tree": True}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=3)
+        xt = x[:20].copy()
+        xt[:, 0] = np.nan
+        assert np.isfinite(bst.predict(xt)).all()
+
+    def test_valid_eval_with_linear(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(2000, 3)
+        y = (x[:, 0] + 0.2 * rs.randn(2000)).astype(np.float32)
+        ds = lgb.Dataset(x[:1500], label=y[:1500])
+        vds = lgb.Dataset(x[1500:], label=y[1500:], reference=ds)
+        rec = {}
+        lgb.train({"objective": "regression", "num_leaves": 5, "max_bin": 31,
+                   "linear_tree": True, "metric": ["l2"]},
+                  ds, num_boost_round=10, valid_sets=[vds],
+                  callbacks=[lgb.record_evaluation(rec)])
+        l2 = rec["valid_0"]["l2"]
+        assert l2[-1] < l2[0]
