@@ -122,6 +122,11 @@ def refit(booster: Booster, x: np.ndarray, y: np.ndarray,
     (GBDT::RefitTree, gbdt.cpp:287-323): per tree, route rows to leaves,
     recompute the regularized optimal output from the new gradients, and
     blend with ``refit_decay_rate``."""
+    if any(t.is_linear for t in booster.trees):
+        raise ValueError(
+            "refit is not supported for linear-tree models: only the "
+            "constant leaf values would be re-fit, leaving the leaf linear "
+            "models stale")
     from .objectives import create_objective
     obj = create_objective(booster.config)
     from .dataset import Metadata

@@ -99,6 +99,7 @@ _PARAMS: Dict[str, tuple] = {
     "data_random_seed": (int, 1, ["data_seed"]),
     "is_enable_sparse": (bool, True, ["is_sparse", "enable_sparse", "sparse"]),
     "enable_bundle": (bool, True, ["is_enable_bundle", "bundle"]),
+    "max_conflict_rate": (float, 0.0, []),
     "use_missing": (bool, True, []),
     "zero_as_missing": (bool, False, []),
     "feature_pre_filter": (bool, True, []),

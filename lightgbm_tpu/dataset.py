@@ -23,6 +23,7 @@ import numpy as np
 
 from .binning import BinMapper, BinType, MissingType
 from .config import Config
+from .efb import EFBInfo, bin_grouped, find_bundles, unbundle
 
 
 class Metadata:
@@ -139,6 +140,7 @@ class Dataset:
         self.feature_names: List[str] = []
         self.raw_data: Optional[np.ndarray] = None
         self.max_bin: int = 255
+        self.efb: Optional[EFBInfo] = None  # set when bundling merged columns
 
     # ------------------------------------------------------------------
     def construct(self, config: Optional[Config] = None) -> "Dataset":
@@ -183,6 +185,7 @@ class Dataset:
             self.used_features = ref.used_features
             self.bin_offsets = ref.bin_offsets
             self.max_bin = ref.max_bin
+            self.efb = ref.efb
         else:
             self._fit_bin_mappers(arr, cfg, cat_idx)
 
@@ -221,13 +224,45 @@ class Dataset:
         self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
         self.max_bin = max([2] + nbins)
 
+        if cfg.enable_bundle and len(self.used_features) > 1:
+            # EFB over the fitting sample (FastFeatureBundling,
+            # dataset.cpp:239; see efb.py)
+            mappers = [self.bin_mappers[f] for f in self.used_features]
+            sample_bins = np.column_stack(
+                [m.value_to_bin(sample[:, f]) for m, f
+                 in zip(mappers, self.used_features)])
+            efb = find_bundles(
+                sample_bins,
+                np.asarray([m.num_bin for m in mappers]),
+                np.asarray([m.bin_type == BinType.CATEGORICAL
+                            for m in mappers]),
+                np.asarray([m.most_freq_bin for m in mappers]),
+                max_conflict_rate=cfg.max_conflict_rate)
+            self.efb = efb if efb.any_bundled else None
+
     def _bin_data(self, arr: np.ndarray) -> None:
         nf = len(self.used_features)
+        if self.efb is not None:
+            self.binned = bin_grouped(
+                lambda j: self.bin_mappers[self.used_features[j]]
+                .value_to_bin(arr[:, self.used_features[j]]),
+                self.efb, self.num_data)
+            return
         dtype = np.uint8 if self.max_bin <= 256 else np.uint16
         out = np.zeros((self.num_data, max(nf, 1)), dtype=dtype)
         for j, f in enumerate(self.used_features):
             out[:, j] = self.bin_mappers[f].value_to_bin(arr[:, f]).astype(dtype)
         self.binned = out
+
+    def feature_binned(self) -> np.ndarray:
+        """Per-feature binned matrix [N, F] (ungrouping EFB bundles if
+        present) — for learners that take the flat layout."""
+        self.construct()
+        if self.efb is None:
+            return self.binned
+        nb = np.asarray([self.bin_mappers[f].num_bin
+                         for f in self.used_features])
+        return unbundle(self.binned, self.efb, nb)
 
     # ------------------------------------------------------------------
     @property
@@ -345,6 +380,14 @@ class Dataset:
             payload["init_score"] = self.metadata.init_score
         if self.raw_data is not None:
             payload["raw_data"] = self.raw_data
+        if self.efb is not None:
+            payload["efb_group_of_feat"] = self.efb.group_of_feat
+            payload["efb_off_of_feat"] = self.efb.off_of_feat
+            payload["efb_group_num_bin"] = self.efb.group_num_bin
+            payload["efb_group_sizes"] = np.asarray(
+                [len(g) for g in self.efb.groups], np.int32)
+            payload["efb_group_members"] = np.asarray(
+                [j for g in self.efb.groups for j in g], np.int32)
         np.savez_compressed(path, **payload)
 
     @classmethod
@@ -380,6 +423,18 @@ class Dataset:
         if "init_score" in z.files:
             ds.metadata.init_score = z["init_score"]
         ds.raw_data = z["raw_data"] if "raw_data" in z.files else None
+        ds.efb = None
+        if "efb_group_of_feat" in z.files:
+            sizes = z["efb_group_sizes"]
+            members = [int(x) for x in z["efb_group_members"]]
+            groups, pos = [], 0
+            for sz in sizes:
+                groups.append(members[pos:pos + int(sz)])
+                pos += int(sz)
+            ds.efb = EFBInfo(groups=groups,
+                             group_of_feat=z["efb_group_of_feat"],
+                             off_of_feat=z["efb_off_of_feat"],
+                             group_num_bin=z["efb_group_num_bin"])
         return ds
 
     def num_bins_of(self, used_feature_slot: int) -> int:

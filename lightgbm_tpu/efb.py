@@ -1,0 +1,224 @@
+"""EFB — exclusive feature bundling.
+
+Analog of the reference's ``Dataset::FindGroups`` / ``FastFeatureBundling``
+(/root/reference/src/io/dataset.cpp:100, :239): sparse, mutually-exclusive
+features (e.g. one-hot blocks) are folded into one shared column so the
+binned matrix narrows from F to G columns — on TPU this cuts the HBM bytes
+streamed per histogram pass, which is the bandwidth-bound term.
+
+Scheme (bundle of features j1..jk, each with default bin 0):
+  group bin 0            = every constituent at its default bin
+  group bins [off_j, off_j + nb_j - 1)  = feature j's bins 1..nb_j-1
+Per-feature histograms are reconstructed on device by a gather over the
+group histogram plus the reference's FixHistogram trick
+(/root/reference/src/io/dataset.cpp:1292): the default bin is recovered as
+``leaf_total - sum(other bins)``.  With ``max_conflict_rate=0`` (default)
+bundling is exactly lossless — split decisions match the unbundled run
+bit-for-bit; a nonzero rate trades accuracy for width like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+
+class EFBInfo(NamedTuple):
+    """Bundling description, feature indices in used-feature slot space."""
+    groups: List[List[int]]          # per group: constituent feature slots
+    group_of_feat: np.ndarray        # [F] int32
+    off_of_feat: np.ndarray          # [F] int32; -1 => identity (singleton)
+    group_num_bin: np.ndarray        # [G] int32
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def max_group_bin(self) -> int:
+        return int(self.group_num_bin.max()) if len(self.group_num_bin) else 2
+
+    @property
+    def any_bundled(self) -> bool:
+        return bool((self.off_of_feat >= 0).any())
+
+
+def find_bundles(sample_bins: np.ndarray, num_bin: np.ndarray,
+                 is_cat: np.ndarray, most_freq_bin: np.ndarray,
+                 max_conflict_rate: float = 0.0,
+                 max_group_bins: int = 2048,
+                 dense_rate: float = 0.8) -> EFBInfo:
+    """Greedy conflict-bounded grouping (FindGroups, dataset.cpp:100).
+
+    sample_bins: [S, F] binned sample rows used for conflict counting.
+    Only numerical features whose default (most frequent) bin is 0 and whose
+    non-default rate is <= dense_rate are bundling candidates; everything
+    else gets a singleton group.  ``max_group_bins`` bounds a bundle's bin
+    axis so the Pallas histogram tile (hist_pallas.py, [block, group_bins]
+    in VMEM) stays well under the ~16 MB VMEM budget — oversize bundles are
+    split into multiple groups automatically.
+    """
+    s, f = sample_bins.shape
+    budget = int(max_conflict_rate * s)
+    nz = sample_bins != 0                       # [S, F] non-default mask
+    nz_count = nz.sum(axis=0)
+
+    eligible = [j for j in range(f)
+                if not is_cat[j] and most_freq_bin[j] == 0
+                and nz_count[j] <= dense_rate * s]
+    # densest first so heavy features seed groups (reference sorts by
+    # conflict count; non-zero count is the same ordering at rate 0)
+    eligible.sort(key=lambda j: -int(nz_count[j]))
+
+    groups: List[List[int]] = []
+    group_masks: List[np.ndarray] = []          # [S] bool occupancy
+    group_conflicts: List[int] = []
+    group_bins: List[int] = []                  # 1 + sum(nb-1)
+    for j in eligible:
+        placed = False
+        for gi in range(len(groups)):
+            if group_bins[gi] + int(num_bin[j]) - 1 > max_group_bins:
+                continue
+            conflicts = int((group_masks[gi] & nz[:, j]).sum())
+            if group_conflicts[gi] + conflicts <= budget:
+                groups[gi].append(j)
+                group_masks[gi] |= nz[:, j]
+                group_conflicts[gi] += conflicts
+                group_bins[gi] += int(num_bin[j]) - 1
+                placed = True
+                break
+        if not placed:
+            groups.append([j])
+            group_masks.append(nz[:, j].copy())
+            group_conflicts.append(0)
+            group_bins.append(1 + int(num_bin[j]) - 1)
+
+    # drop the synthetic bin-0 for groups that stayed singletons, and add
+    # singleton groups for ineligible features
+    final_groups: List[List[int]] = []
+    final_bins: List[int] = []
+    for gi, g in enumerate(groups):
+        if len(g) == 1:
+            final_groups.append(g)
+            final_bins.append(int(num_bin[g[0]]))
+        else:
+            final_groups.append(g)
+            final_bins.append(group_bins[gi])
+    in_bundle = {j for g in final_groups for j in g}
+    for j in range(f):
+        if j not in in_bundle:
+            final_groups.append([j])
+            final_bins.append(int(num_bin[j]))
+
+    group_of = np.zeros(f, np.int32)
+    off_of = np.full(f, -1, np.int32)
+    for gi, g in enumerate(final_groups):
+        if len(g) == 1:
+            group_of[g[0]] = gi
+        else:
+            off = 1
+            for j in g:
+                group_of[j] = gi
+                off_of[j] = off
+                off += int(num_bin[j]) - 1
+    return EFBInfo(groups=final_groups, group_of_feat=group_of,
+                   off_of_feat=off_of,
+                   group_num_bin=np.asarray(final_bins, np.int32))
+
+
+def bin_grouped(feature_cols, efb: EFBInfo, num_data: int) -> np.ndarray:
+    """Fold per-feature bin columns into the grouped matrix [N, G].
+
+    ``feature_cols(j) -> [N] int array`` supplies feature j's bins lazily so
+    the full [N, F] matrix never materializes for wide sparse data.
+    """
+    dtype = np.uint8 if efb.max_group_bin <= 256 else np.uint16
+    out = np.zeros((num_data, efb.num_groups), dtype=dtype)
+    for gi, g in enumerate(efb.groups):
+        if len(g) == 1:
+            out[:, gi] = feature_cols(g[0]).astype(dtype)
+        else:
+            col = np.zeros(num_data, dtype=np.int64)
+            for j in g:
+                b = feature_cols(j)
+                nzr = b != 0
+                col[nzr] = int(efb.off_of_feat[j]) + b[nzr] - 1
+            out[:, gi] = col.astype(dtype)
+    return out
+
+
+def unbundle(binned_grouped: np.ndarray, efb: EFBInfo,
+             num_bin: np.ndarray) -> np.ndarray:
+    """Reconstruct the per-feature binned matrix [N, F] (for learners that
+    do not take the grouped layout, e.g. the distributed shard_map path)."""
+    f = len(efb.group_of_feat)
+    dtype = np.uint8 if int(num_bin.max()) <= 256 else np.uint16
+    out = np.zeros((binned_grouped.shape[0], f), dtype=dtype)
+    for j in range(f):
+        g = int(efb.group_of_feat[j])
+        gcol = binned_grouped[:, g].astype(np.int64)
+        off = int(efb.off_of_feat[j])
+        if off < 0:
+            out[:, j] = gcol.astype(dtype)
+        else:
+            hi = off + int(num_bin[j]) - 1
+            sel = (gcol >= off) & (gcol < hi)
+            out[sel, j] = (gcol[sel] - off + 1).astype(dtype)
+    return out
+
+
+def expansion_maps(efb: EFBInfo, num_bin: np.ndarray, max_bin: int):
+    """Precompute the device gather maps for group->feature histogram
+    expansion: (col_idx [F, B] int32 with -1 = masked, fix0 [F] bool)."""
+    f = len(efb.group_of_feat)
+    col_idx = np.full((f, max_bin), -1, np.int32)
+    fix0 = np.zeros(f, bool)
+    for j in range(f):
+        nb = int(num_bin[j])
+        off = int(efb.off_of_feat[j])
+        if off < 0:
+            col_idx[j, :nb] = np.arange(nb)
+        else:
+            fix0[j] = True
+            col_idx[j, 1:nb] = off + np.arange(nb - 1)
+    return col_idx, fix0
+
+
+class EFBDevice(NamedTuple):
+    """Device-ready bundling state handed to the learner."""
+    group_of_feat: object     # jax [F] int32
+    col_idx: object           # jax [F, B] int32 gather map (-1 = masked)
+    fix0: object              # jax [F] bool
+    off_host: np.ndarray      # host [F] int32 (-1 identity)
+    group_host: np.ndarray    # host [F] int32
+    group_bins: int           # static: max bins over groups
+
+
+def make_device_efb(efb: Optional[EFBInfo], num_bin: np.ndarray,
+                    max_bin: int) -> Optional[EFBDevice]:
+    if efb is None:
+        return None
+    import jax.numpy as jnp
+    col_idx, fix0 = expansion_maps(efb, num_bin, max_bin)
+    return EFBDevice(group_of_feat=jnp.asarray(efb.group_of_feat),
+                     col_idx=jnp.asarray(col_idx), fix0=jnp.asarray(fix0),
+                     off_host=np.asarray(efb.off_of_feat),
+                     group_host=np.asarray(efb.group_of_feat),
+                     group_bins=efb.max_group_bin)
+
+
+def expand_group_hist(ghist, total, group_of_feat, col_idx, fix0):
+    """Device op: group histogram [G, Bg, C] -> feature histogram [F, B, C].
+
+    ``total`` [C] is the leaf's (grad, hess, count) sums, used for the
+    FixHistogram default-bin reconstruction (dataset.cpp:1292 analog).
+    """
+    import jax.numpy as jnp
+    src = jnp.take(ghist, group_of_feat, axis=0)          # [F, Bg, C]
+    idx = jnp.clip(col_idx, 0, ghist.shape[1] - 1)
+    fh = jnp.take_along_axis(src, idx[:, :, None], axis=1)
+    fh = jnp.where((col_idx >= 0)[:, :, None], fh, 0.0)   # [F, B, C]
+    rest = fh[:, 1:, :].sum(axis=1)
+    bin0 = jnp.where(fix0[:, None], total[None, :] - rest, fh[:, 0, :])
+    return fh.at[:, 0, :].set(bin0)

@@ -56,17 +56,22 @@ def _hist_segment(order, binned, vals, begin, count, *, p, num_bins,
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
-def _partition_segment(order, binned, na_bin, feat, thr, dleft, icat,
+def _partition_segment(order, binned, col, nb, goff, nbm1, thr, dleft, icat,
                        rank_vec, begin, count, *, p):
     """Stable in-place partition of order[begin:begin+count] by the split
     predicate (left block first).  Returns (order, left_count).
-    ``rank_vec`` [B] is the decision rank (iota for numerical splits)."""
+    ``rank_vec`` [B] is the decision rank (iota for numerical splits);
+    ``col`` is the binned-matrix column (the EFB group for bundled
+    features), ``nb`` the feature's NaN bin, ``goff``/``nbm1`` the bundle
+    offset (-1 = identity) and num_bin-1 for group-bin unmapping."""
     n = order.shape[0]
     pos = begin + jnp.arange(p, dtype=jnp.int32)
     cpos = jnp.clip(pos, 0, n - 1)
     idx = order[cpos]
-    fcol = binned[idx, feat].astype(jnp.int32)
-    nb = na_bin[feat]
+    gcol = binned[idx, col].astype(jnp.int32)
+    fcol = jnp.where(goff < 0, gcol,
+                     jnp.where((gcol >= goff) & (gcol < goff + nbm1),
+                               gcol - goff + 1, 0))
     is_na = (nb >= 0) & (fcol == nb) & (~icat)
     valid = jnp.arange(p) < count
     go_left = jnp.where(is_na, dleft, rank_vec[fcol] <= thr) & valid
@@ -163,7 +168,8 @@ class PartitionedGrower:
                  max_depth: int = -1, block_rows: int = 0,
                  mono: Optional[np.ndarray] = None,
                  interaction_allow: Optional[np.ndarray] = None,
-                 bynode_frac: float = 1.0, bynode_seed: int = 0):
+                 bynode_frac: float = 1.0, bynode_seed: int = 0,
+                 efb=None):
         self.L = int(num_leaves)
         self.B = int(num_bins)
         self.params = params
@@ -175,18 +181,29 @@ class PartitionedGrower:
         self.bynode_frac = bynode_frac
         self._bynode_rng = np.random.RandomState(bynode_seed)
         self._find = jax.jit(functools.partial(find_best_split, params=params))
+        self.efb = efb  # EFBDevice (efb.py) or None
+        # histogram axis: group bins when bundled, feature bins otherwise
+        self.BH = efb.group_bins if efb is not None else self.B
+        if efb is not None:
+            from .efb import expand_group_hist
+            self._expand = jax.jit(functools.partial(
+                expand_group_hist, group_of_feat=efb.group_of_feat,
+                col_idx=efb.col_idx, fix0=efb.fix0))
 
     def grow(self, binned, vals, feature_mask, num_bin, na_bin,
              is_cat=None, forced=None,
              cegb_state: Optional[CEGBState] = None) -> TreeArrays:
         L, B = self.L, self.B
-        n, f = binned.shape
+        n = binned.shape[0]
         p_full = _pow2(n)
         order = jnp.arange(n, dtype=jnp.int32)
+        nb_host = np.asarray(num_bin)
+        na_host = np.asarray(na_bin)
 
-        # root histogram + split
+        # root histogram + split (over EFB groups when bundled)
         hist0 = _hist_segment(order, binned, vals, jnp.int32(0), jnp.int32(n),
-                              p=p_full, num_bins=B, block_rows=self.block_rows)
+                              p=p_full, num_bins=self.BH,
+                              block_rows=self.block_rows)
         total0 = np.asarray(hist0[0].sum(axis=0))
         root_out = float(leaf_output(jnp.float32(total0[0]),
                                      jnp.float32(total0[1]), self.params))
@@ -217,6 +234,8 @@ class PartitionedGrower:
             if cegb_state is not None and cegb_state.active:
                 kw["gain_penalty"] = jnp.asarray(
                     cegb_state.penalty_vector(total[2]))
+            if self.efb is not None:
+                hist = self._expand(hist, jnp.asarray(total, jnp.float32))
             return self._find(hist, jnp.asarray(total, jnp.float32),
                               num_bin, na_bin, _node_mask(leaf_mask[leaf]),
                               parent_output=jnp.float32(pout),
@@ -284,8 +303,15 @@ class PartitionedGrower:
             # partition the leaf's segment
             begin, cnt = begins[leaf], counts[leaf]
             p_seg = min(_pow2(max(cnt, 1)), p_full)
+            if self.efb is not None:
+                col = int(self.efb.group_host[rec.feature])
+                goff = int(self.efb.off_host[rec.feature])
+            else:
+                col, goff = rec.feature, -1
             order, cl_dev = _partition_segment(
-                order, binned, na_bin, jnp.int32(rec.feature),
+                order, binned, jnp.int32(col),
+                jnp.int32(na_host[rec.feature]), jnp.int32(goff),
+                jnp.int32(nb_host[rec.feature] - 1),
                 jnp.int32(rec.threshold), jnp.bool_(rec.default_left),
                 jnp.bool_(rec.is_cat), jnp.asarray(rec.bin_rank),
                 jnp.int32(begin), jnp.int32(cnt), p=p_seg)
@@ -313,7 +339,8 @@ class PartitionedGrower:
             hist_sm = _hist_segment(order, binned, vals,
                                     jnp.int32(begins[sm]),
                                     jnp.int32(counts[sm]), p=p_sm,
-                                    num_bins=B, block_rows=self.block_rows)
+                                    num_bins=self.BH,
+                                    block_rows=self.block_rows)
             hist_lg = hists[leaf] - hist_sm
             hists[sm], hists[lg] = hist_sm, hist_lg
             totals[leaf] = rec.left_sum
@@ -357,7 +384,9 @@ class PartitionedGrower:
             queue = [(forced, 0)]
             while queue and next_node < node_budget:
                 spec, leaf = queue.pop(0)
-                rec = self._forced_record(spec, hists[leaf], totals[leaf],
+                fh = hists[leaf] if self.efb is None else self._expand(
+                    hists[leaf], jnp.asarray(totals[leaf], jnp.float32))
+                rec = self._forced_record(spec, fh, totals[leaf],
                                           parent_out[leaf], B)
                 if rec is None:
                     continue

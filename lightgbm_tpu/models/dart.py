@@ -49,7 +49,8 @@ class DARTModel(GBDTModel):
         dt = self.device_trees[ti * self.num_class + k]
         w = self.tree_weights[ti * self.num_class + k]
         zero = jnp.zeros(binned.shape[0], jnp.float32)
-        return _apply_tree(zero, binned, dt, self.na_bin_dev, w)
+        return _apply_tree(zero, binned, dt, self.na_bin_dev, w,
+                           self.efb_maps)
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         self._drop_idx = self._select_drop()
@@ -97,7 +98,7 @@ class DARTModel(GBDTModel):
                     dt = st["trees"][k]
                     from .gbdt import _apply_tree
                     ns = _apply_tree(vs[:, k], vb, dt, self.na_bin_dev,
-                                     new_factor - 1.0)
+                                     new_factor - 1.0, self.efb_maps)
                     self.valid_sets[vi] = (vds, vb, vs.at[:, k].set(ns))
             # scale dropped trees and restore their (rescaled) contribution
             for ti in self._drop_idx:

@@ -47,13 +47,14 @@ class _DeviceTree:
         self.steps = steps
 
 
-def _apply_tree(score_vec, binned, dt: _DeviceTree, na_bin, weight: float):
+def _apply_tree(score_vec, binned, dt: _DeviceTree, na_bin, weight: float,
+                efb_maps=None):
     """score_vec += weight * tree(binned)."""
     return add_tree_score(
         score_vec, binned, dt.split_feature, dt.threshold_bin,
         dt.default_left, dt.left_child, dt.right_child, na_bin,
         dt.is_cat_node, dt.cat_rank, dt.leaf_value, jnp.float32(weight),
-        steps=dt.steps)
+        efb_maps, steps=dt.steps)
 
 
 class GBDTModel:
@@ -75,8 +76,13 @@ class GBDTModel:
         if self.num_features == 0:
             raise ValueError("Dataset has no usable (non-trivial) features")
 
-        # device-resident binned matrix + per-feature bin metadata
-        self.binned_dev = jnp.asarray(ds.binned)
+        # device-resident binned matrix + per-feature bin metadata.
+        # EFB (efb.py): the grouped layout is kept for the partitioned
+        # learner; other learners take the flat per-feature layout.
+        self._use_efb = (ds.efb is not None and hist_reduce is None
+                         and config.tpu_learner == "partitioned")
+        self.binned_dev = jnp.asarray(ds.binned if self._use_efb
+                                      else ds.feature_binned())
         num_bin = np.asarray([ds.bin_mappers[f].num_bin for f in ds.used_features],
                              np.int32)
         na_bin = np.asarray([ds.bin_mappers[f].na_bin for f in ds.used_features],
@@ -88,6 +94,15 @@ class GBDTModel:
                              for f in ds.used_features], bool)
         self.is_cat_dev = jnp.asarray(is_cat) if is_cat.any() else None
         self.max_bin = int(num_bin.max())
+        if self._use_efb:
+            from ..efb import make_device_efb
+            self.efb_dev = make_device_efb(ds.efb, num_bin, self.max_bin)
+            self.efb_maps = (self.efb_dev.group_of_feat,
+                             jnp.asarray(ds.efb.off_of_feat),
+                             jnp.asarray(num_bin - 1))
+        else:
+            self.efb_dev = None
+            self.efb_maps = None
 
         self.split_params = SplitParams(
             lambda_l1=config.lambda_l1,
@@ -126,7 +141,8 @@ class GBDTModel:
                 block_rows=config.rows_per_block, mono=mono,
                 interaction_allow=inter,
                 bynode_frac=config.feature_fraction_bynode,
-                bynode_seed=config.feature_fraction_seed + 1)
+                bynode_seed=config.feature_fraction_seed + 1,
+                efb=self.efb_dev)
         else:
             if has_node_controls:
                 raise ValueError(
@@ -209,7 +225,10 @@ class GBDTModel:
             ok = ~np.isnan(X).any(axis=1)
             if ok.sum() < len(feats) + 2:
                 continue
-            X, gg, hh = X[ok], g_np[rows][ok], h_np[rows][ok]
+            # bagging/GOSS amplification weights scale g and h exactly as
+            # in the histogram path (goss.hpp weight amplification)
+            ww = w_np[rows][ok]
+            X, gg, hh = X[ok], g_np[rows][ok] * ww, h_np[rows][ok] * ww
             Xt = np.column_stack([X, np.ones(len(X))])
             A = Xt.T @ (hh[:, None] * Xt)
             A[np.arange(len(feats)), np.arange(len(feats))] += lam
@@ -229,19 +248,7 @@ class GBDTModel:
     def _linear_outputs(ht: Tree, leaves: np.ndarray,
                         raw: np.ndarray) -> np.ndarray:
         """Per-row outputs of a linear tree given row->leaf assignment."""
-        out = ht.leaf_value[leaves].astype(np.float64)
-        for leaf in range(ht.num_leaves):
-            feats = ht.leaf_features[leaf]
-            if not feats:
-                continue
-            m = leaves == leaf
-            if not m.any():
-                continue
-            sub = raw[np.ix_(m, feats)].astype(np.float64)
-            val = ht.leaf_const[leaf] + sub @ np.asarray(ht.leaf_coeff[leaf])
-            out[m] = np.where(np.isnan(sub).any(axis=1), ht.leaf_value[leaf],
-                              val)
-        return out
+        return ht.linear_leaf_outputs(leaves, raw)
 
     @staticmethod
     def _make_cegb(config: Config, ds: Dataset):
@@ -329,7 +336,8 @@ class GBDTModel:
     # -- plumbing ----------------------------------------------------------
     def add_valid_set(self, valid: Dataset) -> None:
         valid.construct(self.config)
-        binned = jnp.asarray(valid.binned)
+        binned = jnp.asarray(valid.binned if self._use_efb
+                             else valid.feature_binned())
         init = np.zeros((valid.num_data, self.num_class), np.float32)
         if valid.metadata.init_score is not None:
             init += np.asarray(valid.metadata.init_score, np.float32) \
@@ -344,14 +352,14 @@ class GBDTModel:
                     binned, dt.split_feature, dt.threshold_bin,
                     dt.default_left, dt.left_child, dt.right_child,
                     self.na_bin_dev, dt.is_cat_node, dt.cat_rank,
-                    steps=dt.steps))
+                    self.efb_maps, steps=dt.steps))
                 delta = self._linear_outputs(ht, leaves, valid.raw_data)
                 score = score.at[:, k].add(
                     self.tree_weights[ti] * jnp.asarray(delta, jnp.float32))
             else:
                 score = score.at[:, k].set(_apply_tree(
                     score[:, k], binned, dt, self.na_bin_dev,
-                    self.tree_weights[ti]))
+                    self.tree_weights[ti], self.efb_maps))
         self.valid_sets.append((valid, binned, score))
 
     # -- sampling (gbdt.cpp:230 Bagging + goss.hpp) ------------------------
@@ -450,7 +458,8 @@ class GBDTModel:
 
         stopped = True
         iter_trees: List[Tree] = []
-        iter_state = {"leaf_of_rows": [], "leaf_values": [], "trees": []}
+        iter_state = {"leaf_of_rows": [], "leaf_values": [], "trees": [],
+                      "train_deltas": [], "valid_deltas": []}
         for k in range(self.num_class):
             g, h = g_all[:, k], h_all[:, k]
             if self._goss:
@@ -508,10 +517,9 @@ class GBDTModel:
                 ht.leaf_value = leaf_values[:max(nl, 1)].copy()
                 self._fit_linear_leaves(arrays, ht, g, h, w, shrinkage, 0.0)
                 lor_np = np.asarray(arrays.leaf_of_row)
-                delta_np = self._linear_outputs(ht, lor_np,
-                                                self.train_set.raw_data)
-                self.score = self.score.at[:, k].add(
-                    jnp.asarray(delta_np, jnp.float32))
+                delta = jnp.asarray(self._linear_outputs(
+                    ht, lor_np, self.train_set.raw_data), jnp.float32)
+                self.score = self.score.at[:, k].add(delta)
                 if init_scores[k] != 0.0:
                     ht.leaf_value += init_scores[k]
                     ht.leaf_const += init_scores[k]
@@ -522,6 +530,7 @@ class GBDTModel:
                 lv_dev = jnp.asarray(dev_values, jnp.float32)
                 delta = jnp.take(lv_dev, arrays.leaf_of_row)
                 self.score = self.score.at[:, k].add(delta)
+            iter_state["train_deltas"].append(delta)
 
             steps = round_up_pow2(max(ht.max_depth(), 1))
             dt = _DeviceTree(arrays, dev_values, steps)
@@ -531,21 +540,27 @@ class GBDTModel:
             iter_state["leaf_values"].append(lv_dev)
             iter_state["trees"].append(dt)
 
-            # validation score updates
+            # validation score updates (per-set deltas kept so
+            # rollback_one_iter removes exactly what was added, including
+            # linear-leaf outputs)
+            vdeltas = []
             for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
                 if linear:
                     vleaves = np.asarray(traverse_tree_binned(
                         vbinned, dt.split_feature, dt.threshold_bin,
                         dt.default_left, dt.left_child, dt.right_child,
                         self.na_bin_dev, dt.is_cat_node, dt.cat_rank,
-                        steps=dt.steps))
+                        self.efb_maps, steps=dt.steps))
                     vdelta = self._linear_outputs(ht, vleaves, vds.raw_data) \
                         - (init_scores[k] if init_scores[k] != 0.0 else 0.0)
-                    ns = vscore[:, k] + jnp.asarray(vdelta, jnp.float32)
+                    vd = jnp.asarray(vdelta, jnp.float32)
                 else:
-                    ns = _apply_tree(vscore[:, k], vbinned, dt,
-                                     self.na_bin_dev, 1.0)
-                self.valid_sets[vi] = (vds, vbinned, vscore.at[:, k].set(ns))
+                    vd = _apply_tree(jnp.zeros_like(vscore[:, k]), vbinned,
+                                     dt, self.na_bin_dev, 1.0, self.efb_maps)
+                vdeltas.append(vd)
+                self.valid_sets[vi] = (vds, vbinned,
+                                       vscore.at[:, k].add(vd))
+            iter_state["valid_deltas"].append(vdeltas)
 
         self.models.extend(iter_trees)
         self._last_iter_state = iter_state
@@ -558,13 +573,11 @@ class GBDTModel:
             return
         st = self._last_iter_state
         for k in range(self.num_class):
-            delta = jnp.take(st["leaf_values"][k], st["leaf_of_rows"][k])
-            self.score = self.score.at[:, k].add(-delta)
-            dt = st["trees"][k]
+            self.score = self.score.at[:, k].add(-st["train_deltas"][k])
             for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
-                ns = _apply_tree(vscore[:, k], vbinned, dt, self.na_bin_dev,
-                                 -1.0)
-                self.valid_sets[vi] = (vds, vbinned, vscore.at[:, k].set(ns))
+                if vi < len(st["valid_deltas"][k]):
+                    vscore = vscore.at[:, k].add(-st["valid_deltas"][k][vi])
+                    self.valid_sets[vi] = (vds, vbinned, vscore)
         del self.models[-self.num_class:]
         del self.device_trees[-self.num_class:]
         del self.tree_weights[-self.num_class:]

@@ -39,7 +39,6 @@ def hist_block_rows(num_features: int, num_bins: int,
     return max(blk, 8)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "block_rows"))
 def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
                       block_rows: int = 0) -> jax.Array:
     """hist[f, b, c] = sum over rows n of (binned[n,f]==b) * vals[n,c].
@@ -48,7 +47,27 @@ def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
     vals:   [N, C] float32 per-row accumulands (grad, hess, count-weight);
             rows outside the target leaf / bag must already be zeroed.
     returns [F, num_bins, C] float32.
+
+    Backend: on TPU the Pallas kernel (hist_pallas.py, VMEM-resident
+    accumulator) is used; elsewhere the XLA one-hot-matmul scan below.
+    Override with LGBM_TPU_HIST=matmul|pallas.
     """
+    import os
+    mode = os.environ.get("LGBM_TPU_HIST", "auto")
+    # >4096 bins per feature would blow the kernel's VMEM tile; the scan
+    # path streams arbitrary widths
+    if num_bins <= 4096 and mode != "matmul" \
+            and (mode == "pallas" or jax.default_backend() == "tpu"):
+        from .hist_pallas import compute_histogram_pallas
+        return compute_histogram_pallas(binned, vals, num_bins=num_bins,
+                                        block_rows=block_rows)
+    return _compute_histogram_matmul(binned, vals, num_bins=num_bins,
+                                     block_rows=block_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "block_rows"))
+def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
+                              num_bins: int, block_rows: int = 0) -> jax.Array:
     n, f = binned.shape
     c = vals.shape[1]
     if block_rows <= 0:

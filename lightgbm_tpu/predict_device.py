@@ -23,8 +23,12 @@ from jax import lax
 @functools.partial(jax.jit, static_argnames=("steps",))
 def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
                          left_child, right_child, na_bin, is_cat_node,
-                         cat_rank, *, steps: int):
-    """Return the leaf index for every row of ``binned`` [N, F]."""
+                         cat_rank, efb_maps=None, *, steps: int):
+    """Return the leaf index for every row of ``binned`` [N, F].
+
+    ``efb_maps``: optional (group_of_feat, off_of_feat, nbm1_of_feat) device
+    arrays when ``binned`` is the EFB-grouped matrix [N, G] (efb.py) — the
+    gathered group bin is unmapped to the feature's own bin space."""
     n = binned.shape[0]
     node = jnp.zeros(n, jnp.int32)
 
@@ -32,8 +36,17 @@ def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
         internal = node >= 0
         nid = jnp.maximum(node, 0)
         f = split_feature[nid]
-        v = jnp.take_along_axis(binned, f[:, None].astype(jnp.int32),
+        if efb_maps is None:
+            col = f
+        else:
+            col = efb_maps[0][f]
+        v = jnp.take_along_axis(binned, col[:, None].astype(jnp.int32),
                                 axis=1)[:, 0].astype(jnp.int32)
+        if efb_maps is not None:
+            off, nbm1 = efb_maps[1][f], efb_maps[2][f]
+            v = jnp.where(off < 0, v,
+                          jnp.where((v >= off) & (v < off + nbm1),
+                                    v - off + 1, 0))
         nb = na_bin[f]
         is_na = (nb >= 0) & (v == nb) & (~is_cat_node[nid])
         rank = cat_rank[nid, v]
@@ -48,11 +61,12 @@ def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
 @functools.partial(jax.jit, static_argnames=("steps",))
 def add_tree_score(score, binned, split_feature, threshold_bin, default_left,
                    left_child, right_child, na_bin, is_cat_node, cat_rank,
-                   leaf_value, weight, *, steps: int):
+                   leaf_value, weight, efb_maps=None, *, steps: int):
     """score += weight * tree(binned) — incremental ScoreUpdater step."""
     leaf = traverse_tree_binned(binned, split_feature, threshold_bin,
                                 default_left, left_child, right_child,
-                                na_bin, is_cat_node, cat_rank, steps=steps)
+                                na_bin, is_cat_node, cat_rank, efb_maps,
+                                steps=steps)
     return score + weight * jnp.take(leaf_value, leaf)
 
 

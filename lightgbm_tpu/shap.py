@@ -141,6 +141,10 @@ def predict_contrib(booster, x: np.ndarray, t0: int, t1: int) -> np.ndarray:
     """Booster-level SHAP (LGBM_BoosterPredictForMat + predict_contrib)."""
     n, nf = x.shape
     k = booster._num_tree_per_iteration
+    if any(booster.trees[ti].is_linear for ti in range(t0, t1)):
+        raise ValueError(
+            "pred_contrib (SHAP) is not supported for linear-tree models "
+            "(contributions would ignore the leaf linear terms)")
     out = np.zeros((n, k, nf + 1))
     for ti in range(t0, t1):
         t = booster.trees[ti]

@@ -194,22 +194,27 @@ class Tree:
         leaves = self.predict_leaf(X)
         if not self.is_linear:
             return self.leaf_value[leaves]
-        # linear leaves: const + coeffs; rows with NaN in used features fall
-        # back to the constant leaf_value (linear_tree_learner.cpp nan path)
-        out = np.zeros(len(X), np.float64)
+        return self.linear_leaf_outputs(leaves, X)
+
+    def linear_leaf_outputs(self, leaves: np.ndarray,
+                            X: np.ndarray) -> np.ndarray:
+        """Linear-leaf outputs given row->leaf: const + coeffs on raw
+        feature values; rows with NaN in used features fall back to the
+        constant leaf_value (linear_tree_learner.cpp nan path).  Single
+        implementation shared by model prediction and train/valid score
+        replay."""
+        out = self.leaf_value[leaves].astype(np.float64)
         for leaf in range(self.num_leaves):
+            feats = self.leaf_features[leaf]
+            if not feats:
+                continue
             m = leaves == leaf
             if not m.any():
                 continue
-            feats = self.leaf_features[leaf]
-            if not feats:
-                out[m] = self.leaf_value[leaf]
-                continue
             sub = X[np.ix_(m, feats)].astype(np.float64)
             val = self.leaf_const[leaf] + sub @ np.asarray(self.leaf_coeff[leaf])
-            nan_rows = np.isnan(sub).any(axis=1)
-            val = np.where(nan_rows, self.leaf_value[leaf], val)
-            out[m] = val
+            out[m] = np.where(np.isnan(sub).any(axis=1),
+                              self.leaf_value[leaf], val)
         return out
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:

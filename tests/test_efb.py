@@ -1,0 +1,109 @@
+"""EFB (exclusive feature bundling) tests — efb.py + dataset/grower wiring.
+
+Mirrors the reference's EFB coverage (Dataset::FindGroups /
+FastFeatureBundling, dataset.cpp:100, :239): bundling must be lossless at
+max_conflict_rate=0, i.e. the trained model must match the unbundled run.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.efb import find_bundles, bin_grouped, unbundle
+
+
+def _onehot_data(n=3000, n_dense=3, n_cats=12, seed=0):
+    """Dense features + a mutually-exclusive one-hot block."""
+    rs = np.random.RandomState(seed)
+    dense = rs.randn(n, n_dense)
+    cat = rs.randint(0, n_cats, size=n)
+    onehot = np.zeros((n, n_cats))
+    onehot[np.arange(n), cat] = 1.0
+    x = np.column_stack([dense, onehot])
+    y = (dense[:, 0] + (cat % 3 == 0) + 0.2 * rs.randn(n) > 0.5)
+    return x, y.astype(np.float32)
+
+
+class TestFindBundles:
+    def test_exclusive_block_bundles(self):
+        rs = np.random.RandomState(1)
+        n, k = 500, 8
+        cat = rs.randint(0, k, size=n)
+        bins = np.zeros((n, k), np.int64)
+        bins[np.arange(n), cat] = 1  # bin 1 = "one", bin 0 default
+        efb = find_bundles(bins, np.full(k, 2), np.zeros(k, bool),
+                           np.zeros(k, np.int64))
+        assert efb.num_groups == 1
+        assert efb.any_bundled
+        # group bins: 1 + k * (2-1)
+        assert efb.group_num_bin[0] == 1 + k
+
+    def test_conflicting_features_not_bundled(self):
+        rs = np.random.RandomState(2)
+        bins = rs.randint(1, 5, size=(200, 3))  # dense, always non-default
+        efb = find_bundles(bins, np.full(3, 5), np.zeros(3, bool),
+                           np.zeros(3, np.int64))
+        assert not efb.any_bundled
+
+    def test_roundtrip_unbundle(self):
+        rs = np.random.RandomState(3)
+        n, k = 400, 6
+        cat = rs.randint(0, k, size=n)
+        bins = np.zeros((n, k), np.int64)
+        bins[np.arange(n), cat] = 1 + (cat % 1)
+        nb = np.full(k, 2)
+        efb = find_bundles(bins, nb, np.zeros(k, bool), np.zeros(k, np.int64))
+        grouped = bin_grouped(lambda j: bins[:, j], efb, n)
+        back = unbundle(grouped, efb, nb)
+        np.testing.assert_array_equal(back, bins)
+
+
+class TestEFBTraining:
+    def test_dataset_narrows(self):
+        x, y = _onehot_data()
+        ds = lgb.Dataset(x, label=y).construct()
+        assert ds.efb is not None
+        assert ds.binned.shape[1] < ds.num_features
+
+    def test_lossless_vs_unbundled(self):
+        x, y = _onehot_data()
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "min_data_in_leaf": 20, "num_boost_round": 10}
+        b1 = lgb.train({**params, "enable_bundle": True},
+                       lgb.Dataset(x, label=y), num_boost_round=10)
+        b2 = lgb.train({**params, "enable_bundle": False},
+                       lgb.Dataset(x, label=y), num_boost_round=10)
+        p1, p2 = b1.predict(x), b2.predict(x)
+        np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+        assert b1._model.train_set.efb is not None
+        assert b2._model.train_set.efb is None
+
+    def test_valid_and_early_stopping(self):
+        x, y = _onehot_data(seed=5)
+        ntr = 2400
+        dtr = lgb.Dataset(x[:ntr], label=y[:ntr])
+        dva = dtr.create_valid(x[ntr:], label=y[ntr:])
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "metric": "binary_logloss", "verbosity": -1},
+                        dtr, num_boost_round=30, valid_sets=[dva],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        assert bst.best_iteration >= 1
+        auc_in = np.mean((bst.predict(x[ntr:]) > 0.5) == y[ntr:])
+        assert auc_in > 0.8
+
+    def test_binary_cache_roundtrip(self, tmp_path):
+        x, y = _onehot_data(seed=7)
+        ds = lgb.Dataset(x, label=y).construct()
+        assert ds.efb is not None
+        path = str(tmp_path / "cache.bin")
+        ds.save_binary(path)
+        ds2 = lgb.Dataset.load_binary(path)
+        assert ds2.efb is not None
+        np.testing.assert_array_equal(ds2.binned, ds.binned)
+        np.testing.assert_array_equal(ds2.efb.group_of_feat,
+                                      ds.efb.group_of_feat)
+        b1 = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                       num_boost_round=5)
+        b2 = lgb.train({"objective": "binary", "verbosity": -1}, ds2,
+                       num_boost_round=5)
+        np.testing.assert_allclose(b1.predict(x), b2.predict(x), rtol=1e-5)
