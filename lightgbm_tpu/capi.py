@@ -1,0 +1,149 @@
+"""ctypes binding to the native C API inference runtime.
+
+Mirrors the reference's Python->C prediction path (basic.py:112 _load_lib,
+_InnerPredictor -> LGBM_BoosterPredictForMat, c_api.h:1072): the model is
+parsed and traversed entirely in C++ (native/capi.cpp), with OpenMP row
+parallelism — a dependency-free deployment predictor for models trained by
+the JAX/TPU layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .native import load_lib
+
+_PRED_NORMAL = 0
+_PRED_RAW = 1
+_PRED_LEAF = 2
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        lib = load_lib("capi.cpp", "libcapi.so")
+        if lib is None:
+            _lib_failed = True
+            return None
+        lib.LGBM_GetLastError.restype = ctypes.c_char_p
+        lib.LGBM_BoosterLoadModelFromString.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.LGBM_BoosterCreateFromModelfile.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.LGBM_BoosterFree.argtypes = [ctypes.c_void_p]
+        for name in ("LGBM_BoosterGetNumClasses", "LGBM_BoosterGetNumFeature",
+                     "LGBM_BoosterGetCurrentIteration"):
+            getattr(lib, name).argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(ctypes.c_int)]
+        lib.LGBM_BoosterPredictForMat.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+        _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+class NativeBooster:
+    """Inference-only booster backed by the C++ runtime.
+
+    Load a saved model file (or string) and predict without JAX in the
+    loop — the deployment-side analog of ``Booster`` prediction.
+    """
+
+    def __init__(self, model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native C API library unavailable "
+                               "(g++ build failed)")
+        self._lib = lib
+        self._handle = ctypes.c_void_p()
+        niter = ctypes.c_int()
+        if model_file is not None:
+            rc = lib.LGBM_BoosterCreateFromModelfile(
+                model_file.encode(), ctypes.byref(niter),
+                ctypes.byref(self._handle))
+        elif model_str is not None:
+            rc = lib.LGBM_BoosterLoadModelFromString(
+                model_str.encode(), ctypes.byref(niter),
+                ctypes.byref(self._handle))
+        else:
+            raise ValueError("need model_file or model_str")
+        if rc != 0:
+            raise RuntimeError(lib.LGBM_GetLastError().decode())
+        self.num_iterations = niter.value
+
+    def _get_int(self, fname: str) -> int:
+        out = ctypes.c_int()
+        getattr(self._lib, fname)(self._handle, ctypes.byref(out))
+        return out.value
+
+    @property
+    def num_classes(self) -> int:
+        return self._get_int("LGBM_BoosterGetNumClasses")
+
+    @property
+    def num_feature(self) -> int:
+        return self._get_int("LGBM_BoosterGetNumFeature")
+
+    def current_iteration(self) -> int:
+        return self._get_int("LGBM_BoosterGetCurrentIteration")
+
+    def predict(self, data, raw_score: bool = False, pred_leaf: bool = False,
+                start_iteration: int = 0,
+                num_iteration: int = -1) -> np.ndarray:
+        x = np.ascontiguousarray(np.asarray(data, np.float64))
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        nrow, ncol = x.shape
+        k = self.num_classes
+        if pred_leaf:
+            ptype = _PRED_LEAF
+            total = self.current_iteration()
+            used = total - start_iteration if num_iteration <= 0 else \
+                min(num_iteration, total - start_iteration)
+            width = max(used, 0) * self._trees_per_iter()
+        else:
+            ptype = _PRED_RAW if raw_score else _PRED_NORMAL
+            width = k
+        out = np.zeros((nrow, width), np.float64)
+        out_len = ctypes.c_int64()
+        rc = self._lib.LGBM_BoosterPredictForMat(
+            self._handle, x, nrow, ncol, ptype, start_iteration,
+            num_iteration, ctypes.byref(out_len), out)
+        if rc != 0:
+            raise RuntimeError(self._lib.LGBM_GetLastError().decode())
+        width_actual = out_len.value // nrow if nrow else width
+        out = out[:, :width_actual] if width_actual < width else out
+        if pred_leaf:
+            return out.astype(np.int32)
+        return out if k > 1 else out[:, 0]
+
+    def _trees_per_iter(self) -> int:
+        return self.num_classes if self.num_classes > 1 else 1
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib.LGBM_BoosterFree(handle)
+            self._handle = None

@@ -26,19 +26,36 @@ _lib = None
 _lib_failed = False
 
 
-def _build() -> bool:
+def build_lib(src: str, so: str) -> bool:
+    """Compile one C++ source into a shared library (OpenMP if available)."""
     cmds = [
-        ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", _SRC, "-o", _SO],
-        ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],  # no-omp fallback
+        ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", src, "-o", so],
+        ["g++", "-O3", "-shared", "-fPIC", src, "-o", so],  # no-omp fallback
     ]
     for cmd in cmds:
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=120)
-            if r.returncode == 0 and os.path.exists(_SO):
+            if r.returncode == 0 and os.path.exists(so):
                 return True
         except (OSError, subprocess.TimeoutExpired):
             continue
     return False
+
+
+def load_lib(src_name: str, so_name: str) -> Optional[ctypes.CDLL]:
+    """Build-on-first-use + dlopen for a native component next to this
+    package; returns None when the toolchain is unavailable."""
+    src = os.path.join(_DIR, src_name)
+    so = os.path.join(_DIR, so_name)
+    if not os.path.exists(so) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so)):
+        if not build_lib(src, so):
+            return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        return None
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -48,14 +65,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _lib_failed:
             return _lib
-        if not os.path.exists(_SO) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            if not _build():
-                _lib_failed = True
-                return None
+        lib = load_lib(os.path.basename(_SRC), os.path.basename(_SO))
+        if lib is None:
+            _lib_failed = True
+            return None
         try:
-            lib = ctypes.CDLL(_SO)
             lib.lgbt_csv_shape.restype = ctypes.c_long
             lib.lgbt_csv_shape.argtypes = [
                 ctypes.c_char_p, ctypes.c_char, ctypes.c_int,

@@ -1,0 +1,389 @@
+// Native inference runtime + C API shim.
+//
+// TPU-native framework counterpart of the reference's C API prediction
+// surface (include/LightGBM/c_api.h:749-1199, src/c_api.cpp Booster
+// prediction paths, gbdt_prediction.cpp inner loop, tree.h:335-412
+// NumericalDecision/CategoricalDecision).  Training runs in the JAX/XLA
+// layer; this module gives deployments a dependency-free native predictor
+// over the (LightGBM-compatible) text model format, exposed with
+// ecosystem-parity LGBM_* entry points callable from C/ctypes/cffi.
+//
+// Built standalone:  g++ -O3 -fopenmp -shared -fPIC capi.cpp -o libcapi.so
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+thread_local std::string g_last_error;
+
+int SetError(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+// ---------------------------------------------------------------------------
+// parsing helpers
+// ---------------------------------------------------------------------------
+
+template <typename T>
+std::vector<T> ParseArray(const std::string& s) {
+  std::vector<T> out;
+  std::istringstream is(s);
+  double v;
+  while (is >> v) out.push_back(static_cast<T>(v));
+  return out;
+}
+
+// key=value map over one text block (header or a single tree)
+struct KVBlock {
+  std::vector<std::pair<std::string, std::string>> items;
+  const std::string* Find(const std::string& key) const {
+    for (const auto& kv : items)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  std::string Get(const std::string& key, const std::string& dflt = "") const {
+    const std::string* p = Find(key);
+    return p ? *p : dflt;
+  }
+};
+
+KVBlock ParseKV(const std::string& text) {
+  KVBlock b;
+  std::istringstream is(text);
+  std::string line;
+  while (std::getline(is, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    size_t eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    b.items.emplace_back(line.substr(0, eq), line.substr(eq + 1));
+  }
+  return b;
+}
+
+// ---------------------------------------------------------------------------
+// tree
+// ---------------------------------------------------------------------------
+
+constexpr int kCategoricalBit = 1;  // decision_type bit 0
+constexpr int kDefaultLeftBit = 2;  // bit 1
+constexpr int kMissingShift = 2;    // bits 2-3: 0 none / 1 zero / 2 nan
+
+struct Tree {
+  int num_leaves = 1;
+  std::vector<int> split_feature, decision_type, left_child, right_child;
+  std::vector<double> threshold, leaf_value;
+  int num_cat = 0;
+  std::vector<int> cat_boundaries;
+  std::vector<uint32_t> cat_threshold;
+  bool is_linear = false;
+  std::vector<double> leaf_const;
+  std::vector<std::vector<int>> leaf_features;
+  std::vector<std::vector<double>> leaf_coeff;
+
+  bool CatContains(int cat_idx, double v) const {
+    if (!std::isfinite(v) || v < 0) return false;
+    int iv = static_cast<int>(v);
+    int lo = cat_boundaries[cat_idx], hi = cat_boundaries[cat_idx + 1];
+    int nbits = 32 * (hi - lo);
+    if (iv >= nbits) return false;
+    return (cat_threshold[lo + iv / 32] >> (iv % 32)) & 1u;
+  }
+
+  int PredictLeaf(const double* row) const {
+    if (num_leaves <= 1) return 0;
+    int node = 0;
+    while (node >= 0) {
+      int dt = decision_type[node];
+      double v = row[split_feature[node]];
+      bool left;
+      if (dt & kCategoricalBit) {
+        left = CatContains(static_cast<int>(threshold[node]), v);
+      } else {
+        int miss = (dt >> kMissingShift) & 3;
+        bool isnan = std::isnan(v);
+        if (isnan && miss != 2) { v = 0.0; isnan = false; }
+        if (isnan)
+          left = (dt & kDefaultLeftBit) != 0;
+        else
+          left = v <= threshold[node];
+      }
+      node = left ? left_child[node] : right_child[node];
+    }
+    return ~node;
+  }
+
+  double Predict(const double* row) const {
+    int leaf = PredictLeaf(row);
+    if (is_linear && !leaf_features[leaf].empty()) {
+      double out = leaf_const[leaf];
+      const auto& feats = leaf_features[leaf];
+      const auto& coef = leaf_coeff[leaf];
+      for (size_t i = 0; i < feats.size(); ++i) {
+        double v = row[feats[i]];
+        if (std::isnan(v)) return leaf_value[leaf];  // NaN fallback
+        out += coef[i] * v;
+      }
+      return out;
+    }
+    return leaf_value[leaf];
+  }
+
+  static Tree FromBlock(const std::string& text) {
+    KVBlock kv = ParseKV(text);
+    Tree t;
+    t.num_leaves = std::stoi(kv.Get("num_leaves", "1"));
+    int n = t.num_leaves > 1 ? t.num_leaves - 1 : 0;
+    t.split_feature = ParseArray<int>(kv.Get("split_feature"));
+    t.threshold = ParseArray<double>(kv.Get("threshold"));
+    t.decision_type = ParseArray<int>(kv.Get("decision_type"));
+    t.left_child = ParseArray<int>(kv.Get("left_child"));
+    t.right_child = ParseArray<int>(kv.Get("right_child"));
+    t.leaf_value = ParseArray<double>(kv.Get("leaf_value"));
+    t.split_feature.resize(n, 0);
+    t.threshold.resize(n, 0.0);
+    t.decision_type.resize(n, 0);
+    t.left_child.resize(n, -1);
+    t.right_child.resize(n, -2);
+    t.leaf_value.resize(t.num_leaves, 0.0);
+    t.num_cat = std::stoi(kv.Get("num_cat", "0"));
+    if (t.num_cat > 0) {
+      t.cat_boundaries = ParseArray<int>(kv.Get("cat_boundaries"));
+      t.cat_threshold = ParseArray<uint32_t>(kv.Get("cat_threshold"));
+    }
+    t.is_linear = std::stoi(kv.Get("is_linear", "0")) != 0;
+    if (t.is_linear) {
+      t.leaf_const = ParseArray<double>(kv.Get("leaf_const"));
+      t.leaf_const.resize(t.num_leaves, 0.0);
+      std::vector<int> counts = ParseArray<int>(kv.Get("num_features"));
+      counts.resize(t.num_leaves, 0);
+      std::vector<int> feats = ParseArray<int>(kv.Get("leaf_features"));
+      std::vector<double> coefs = ParseArray<double>(kv.Get("leaf_coeff"));
+      t.leaf_features.resize(t.num_leaves);
+      t.leaf_coeff.resize(t.num_leaves);
+      size_t pos = 0;
+      for (int leaf = 0; leaf < t.num_leaves; ++leaf) {
+        int c = counts[leaf];
+        for (int j = 0; j < c && pos < feats.size(); ++j, ++pos) {
+          t.leaf_features[leaf].push_back(feats[pos]);
+          if (pos < coefs.size()) t.leaf_coeff[leaf].push_back(coefs[pos]);
+        }
+      }
+    }
+    return t;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// booster
+// ---------------------------------------------------------------------------
+
+enum PredictType { kNormal = 0, kRawScore = 1, kLeafIndex = 2 };
+
+struct Booster {
+  int num_class = 1;
+  int num_tree_per_iteration = 1;
+  int max_feature_idx = 0;
+  bool average_output = false;
+  std::string objective = "regression";
+  double sigmoid = 1.0;
+  std::vector<Tree> trees;
+
+  int NumIterations() const {
+    return num_tree_per_iteration > 0
+               ? static_cast<int>(trees.size()) / num_tree_per_iteration
+               : 0;
+  }
+
+  // output transform — ObjectiveFunction::ConvertOutput analogs
+  // (objectives.py convert_output; reference *_objective.hpp)
+  void ConvertOutput(double* scores) const {
+    if (objective == "binary" || objective == "multiclassova" ||
+        objective == "xentropy" || objective == "cross_entropy") {
+      for (int k = 0; k < num_class; ++k)
+        scores[k] = 1.0 / (1.0 + std::exp(-sigmoid * scores[k]));
+    } else if (objective == "multiclass" || objective == "softmax") {
+      double mx = scores[0];
+      for (int k = 1; k < num_class; ++k) mx = std::max(mx, scores[k]);
+      double sum = 0.0;
+      for (int k = 0; k < num_class; ++k) {
+        scores[k] = std::exp(scores[k] - mx);
+        sum += scores[k];
+      }
+      for (int k = 0; k < num_class; ++k) scores[k] /= sum;
+    } else if (objective == "poisson" || objective == "gamma" ||
+               objective == "tweedie") {
+      for (int k = 0; k < num_class; ++k) scores[k] = std::exp(scores[k]);
+    } else if (objective == "xentlambda" || objective == "cross_entropy_lambda") {
+      for (int k = 0; k < num_class; ++k)
+        scores[k] = std::log1p(std::exp(scores[k]));
+    }
+  }
+
+  void PredictRow(const double* row, int t0, int t1, int type,
+                  double* out) const {
+    if (type == kLeafIndex) {
+      for (int ti = t0; ti < t1; ++ti)
+        out[ti - t0] = static_cast<double>(trees[ti].PredictLeaf(row));
+      return;
+    }
+    for (int k = 0; k < num_class; ++k) out[k] = 0.0;
+    for (int ti = t0; ti < t1; ++ti)
+      out[ti % num_tree_per_iteration] += trees[ti].Predict(row);
+    if (average_output && t1 > t0) {
+      double inv = static_cast<double>(num_tree_per_iteration) / (t1 - t0);
+      for (int k = 0; k < num_class; ++k) out[k] *= inv;
+    }
+    if (type == kNormal) ConvertOutput(out);
+  }
+
+  static Booster* FromString(const std::string& model, std::string* err) {
+    size_t tree_pos = model.find("\nTree=");
+    std::string header = model.substr(0, tree_pos == std::string::npos
+                                             ? model.size() : tree_pos);
+    KVBlock kv = ParseKV(header);
+    if (!kv.Find("num_class") || !kv.Find("max_feature_idx")) {
+      *err = "not a model file (missing num_class/max_feature_idx header)";
+      return nullptr;
+    }
+    Booster* b = new Booster();
+    b->num_class = std::stoi(kv.Get("num_class", "1"));
+    b->num_tree_per_iteration =
+        std::stoi(kv.Get("num_tree_per_iteration",
+                         kv.Get("num_class", "1")));
+    b->max_feature_idx = std::stoi(kv.Get("max_feature_idx", "0"));
+    b->average_output = header.find("\naverage_output") != std::string::npos;
+    std::istringstream obj(kv.Get("objective", "regression"));
+    obj >> b->objective;
+    std::string tok;
+    while (obj >> tok) {
+      size_t c = tok.find(':');
+      if (c != std::string::npos && tok.substr(0, c) == "sigmoid")
+        b->sigmoid = std::stod(tok.substr(c + 1));
+    }
+    // tree blocks: "Tree=i" ... up to next "Tree=" / "end of trees"
+    size_t stop = model.find("\nend of trees");
+    if (stop == std::string::npos) stop = model.size();
+    size_t pos = tree_pos;
+    while (pos != std::string::npos && pos < stop) {
+      size_t start = pos + 1;
+      size_t next = model.find("\nTree=", start);
+      size_t end = next == std::string::npos ? stop : std::min(next, stop);
+      b->trees.push_back(Tree::FromBlock(model.substr(start, end - start)));
+      pos = next;
+    }
+    return b;
+  }
+};
+
+int ResolveIterRange(const Booster* b, int start_iteration, int num_iteration,
+                     int* t0, int* t1) {
+  int k = b->num_tree_per_iteration;
+  int total_iters = b->NumIterations();
+  if (num_iteration <= 0) num_iteration = total_iters;
+  *t0 = start_iteration * k;
+  *t1 = std::min((start_iteration + num_iteration) * k,
+                 static_cast<int>(b->trees.size()));
+  if (*t0 > *t1) *t0 = *t1;
+  return *t1 - *t0;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API (c_api.h parity surface — prediction/model subset)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  std::string err;
+  Booster* b = Booster::FromString(model_str, &err);
+  if (!b) return SetError(err);
+  if (out_num_iterations) *out_num_iterations = b->NumIterations();
+  *out = b;
+  return 0;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  std::ifstream f(filename, std::ios::binary);
+  if (!f) return SetError(std::string("cannot open model file: ") + filename);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string s = ss.str();
+  return LGBM_BoosterLoadModelFromString(s.c_str(), out_num_iterations, out);
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  delete static_cast<Booster*>(handle);
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out) {
+  *out = static_cast<Booster*>(handle)->num_class;
+  return 0;
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
+  *out = static_cast<Booster*>(handle)->max_feature_idx + 1;
+  return 0;
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out) {
+  *out = static_cast<Booster*>(handle)->NumIterations();
+  return 0;
+}
+
+// Dense row-major double matrix prediction.
+// predict_type: 0 normal (transformed), 1 raw score, 2 leaf index.
+// out_result: [nrow * num_class] for 0/1, [nrow * num_trees_used] for 2.
+// out_len: number of doubles written.
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const double* data,
+                              int32_t nrow, int32_t ncol, int predict_type,
+                              int start_iteration, int num_iteration,
+                              int64_t* out_len, double* out_result) {
+  const Booster* b = static_cast<Booster*>(handle);
+  if (ncol < b->max_feature_idx + 1)
+    return SetError("ncol smaller than the model's feature count");
+  int t0, t1;
+  int used = ResolveIterRange(b, start_iteration, num_iteration, &t0, &t1);
+  int width = predict_type == kLeafIndex ? used : b->num_class;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int32_t i = 0; i < nrow; ++i)
+    b->PredictRow(data + static_cast<int64_t>(i) * ncol, t0, t1, predict_type,
+                  out_result + static_cast<int64_t>(i) * width);
+  if (out_len) *out_len = static_cast<int64_t>(nrow) * width;
+  return 0;
+}
+
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const double* data, int32_t ncol,
+                                       int predict_type, int start_iteration,
+                                       int num_iteration, int64_t* out_len,
+                                       double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, 1, ncol, predict_type,
+                                   start_iteration, num_iteration, out_len,
+                                   out_result);
+}
+
+}  // extern "C"

@@ -48,16 +48,18 @@ def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
             rows outside the target leaf / bag must already be zeroed.
     returns [F, num_bins, C] float32.
 
-    Backend: on TPU the Pallas kernel (hist_pallas.py, VMEM-resident
-    accumulator) is used; elsewhere the XLA one-hot-matmul scan below.
-    Override with LGBM_TPU_HIST=matmul|pallas.
+    Backend: the XLA one-hot-matmul scan below on every platform (fastest
+    measured on TPU v5e as well); LGBM_TPU_HIST=pallas selects the
+    experimental Pallas kernel (hist_pallas.py) instead.
     """
     import os
     mode = os.environ.get("LGBM_TPU_HIST", "auto")
-    # >4096 bins per feature would blow the kernel's VMEM tile; the scan
-    # path streams arbitrary widths
-    if num_bins <= 4096 and mode != "matmul" \
-            and (mode == "pallas" or jax.default_backend() == "tpu"):
+    # Default is the XLA one-hot matmul everywhere: measured on TPU v5e
+    # (1M x 28 x 64 bins, amortized in-graph) it runs 4.7 ms vs 8.2 ms for
+    # the best hand-written Pallas variant — XLA fuses the one-hot
+    # generation into the dot better than the explicit kernel.  The Pallas
+    # path is kept for experimentation via LGBM_TPU_HIST=pallas.
+    if mode == "pallas" and num_bins <= 4096:
         from .hist_pallas import compute_histogram_pallas
         return compute_histogram_pallas(binned, vals, num_bins=num_bins,
                                         block_rows=block_rows)

@@ -1,0 +1,107 @@
+"""Native C API inference runtime vs Python predictor parity
+(c_api.h prediction surface analog; tests/c_api_test/test_.py pattern)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.capi import NativeBooster, native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native toolchain unavailable")
+
+
+def _train(params, x, y, rounds=10, **ds_kw):
+    ds = lgb.Dataset(x, label=y, **ds_kw)
+    return lgb.train(dict(params, verbosity=-1), ds, num_boost_round=rounds)
+
+
+def _roundtrip(bst, tmp_path):
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    return NativeBooster(model_file=path)
+
+
+def test_binary_parity(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.randn(500, 8)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 15}, x, y)
+    nb = _roundtrip(bst, tmp_path)
+    xt = rng.randn(100, 8)
+    np.testing.assert_allclose(nb.predict(xt), bst.predict(xt), rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(nb.predict(xt, raw_score=True),
+                               bst.predict(xt, raw_score=True), rtol=2e-5, atol=1e-7)
+    assert nb.num_classes == 1
+    assert nb.num_feature == 8
+    assert nb.current_iteration() == 10
+
+
+def test_multiclass_parity(tmp_path):
+    rng = np.random.RandomState(1)
+    x = rng.randn(600, 6)
+    y = (np.abs(x[:, 0]) + x[:, 1] > 1).astype(int) + (x[:, 2] > 0)
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 7}, x, y.astype(np.float64))
+    nb = _roundtrip(bst, tmp_path)
+    xt = rng.randn(50, 6)
+    np.testing.assert_allclose(nb.predict(xt), bst.predict(xt), rtol=2e-5, atol=1e-7)
+    got = nb.predict(xt)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=2e-5, atol=1e-7)  # softmax
+
+
+def test_missing_and_categorical_parity(tmp_path):
+    rng = np.random.RandomState(2)
+    n = 800
+    x = rng.randn(n, 5)
+    x[rng.rand(n, 5) < 0.2] = np.nan
+    cat = rng.randint(0, 12, size=n).astype(np.float64)
+    x = np.column_stack([x, cat])
+    y = (np.nan_to_num(x[:, 0]) + (cat % 3 == 0)).astype(np.float64)
+    bst = _train({"objective": "regression", "num_leaves": 15}, x, y,
+                 categorical_feature=[5])
+    nb = _roundtrip(bst, tmp_path)
+    xt = x[:200]
+    np.testing.assert_allclose(nb.predict(xt), bst.predict(xt), rtol=2e-5, atol=1e-7)
+
+
+def test_leaf_index_parity(tmp_path):
+    rng = np.random.RandomState(3)
+    x = rng.randn(400, 4)
+    y = x[:, 0] * x[:, 1]
+    bst = _train({"objective": "regression", "num_leaves": 8}, x, y, rounds=5)
+    nb = _roundtrip(bst, tmp_path)
+    xt = rng.randn(30, 4)
+    np.testing.assert_array_equal(nb.predict(xt, pred_leaf=True),
+                                  bst.predict(xt, pred_leaf=True))
+
+
+def test_model_string_and_iter_range(tmp_path):
+    rng = np.random.RandomState(4)
+    x = rng.randn(300, 4)
+    y = x[:, 0] + rng.randn(300) * 0.1
+    bst = _train({"objective": "regression", "num_leaves": 8}, x, y, rounds=8)
+    nb = NativeBooster(model_str=bst.model_to_string())
+    xt = rng.randn(20, 4)
+    np.testing.assert_allclose(
+        nb.predict(xt, num_iteration=3),
+        bst.predict(xt, num_iteration=3), rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        nb.predict(xt, start_iteration=2, num_iteration=4),
+        bst.predict(xt, start_iteration=2, num_iteration=4), rtol=2e-5, atol=1e-7)
+
+
+def test_linear_tree_parity(tmp_path):
+    rng = np.random.RandomState(5)
+    x = rng.randn(500, 3)
+    y = 2.0 * x[:, 0] - x[:, 1] + 0.1 * rng.randn(500)
+    bst = _train({"objective": "regression", "num_leaves": 6,
+                  "linear_tree": True}, x, y, rounds=5)
+    nb = _roundtrip(bst, tmp_path)
+    xt = rng.randn(40, 3)
+    np.testing.assert_allclose(nb.predict(xt), bst.predict(xt), rtol=2e-5, atol=1e-7)
+
+
+def test_error_on_bad_model():
+    with pytest.raises(RuntimeError):
+        NativeBooster(model_str="this is not a model")
