@@ -15,11 +15,11 @@ from .booster import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
-from .dataset import Dataset
+from .dataset import Dataset, Sequence
 from .engine import CVBooster, cv, train
 
 __all__ = [
     "BinMapper", "BinType", "MissingType", "Booster", "Config", "CVBooster",
-    "Dataset", "EarlyStopException", "cv", "early_stopping", "log_evaluation",
-    "record_evaluation", "reset_parameter", "train",
+    "Dataset", "EarlyStopException", "Sequence", "cv", "early_stopping",
+    "log_evaluation", "record_evaluation", "reset_parameter", "train",
 ]

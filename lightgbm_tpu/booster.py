@@ -196,7 +196,21 @@ class Booster:
         """Prediction on raw features (gbdt_prediction.cpp:97 inner loop,
         Predictor analog).  ``pred_early_stop``: margin-based early exit
         across trees (prediction_early_stop.cpp:91)."""
-        from .dataset import _to_numpy_2d
+        from .dataset import _is_scipy_sparse, _to_numpy_2d
+        if _is_scipy_sparse(data) and data.shape[0] > 65536:
+            # CSR prediction (LGBM_BoosterPredictForCSR analog): densify in
+            # row chunks so peak memory stays bounded.
+            csr = data.tocsr()
+            chunks = [self.predict(csr[i:i + 65536],
+                                   start_iteration=start_iteration,
+                                   num_iteration=num_iteration,
+                                   raw_score=raw_score, pred_leaf=pred_leaf,
+                                   pred_contrib=pred_contrib,
+                                   pred_early_stop=pred_early_stop,
+                                   pred_early_stop_freq=pred_early_stop_freq,
+                                   pred_early_stop_margin=pred_early_stop_margin)
+                      for i in range(0, data.shape[0], 65536)]
+            return np.concatenate(chunks, axis=0)
         x, _, _ = _to_numpy_2d(data)
         n = len(x)
         k = self._num_tree_per_iteration
@@ -240,6 +254,13 @@ class Booster:
                 jnp.asarray(score if k > 1 else score[:, 0]))
             return np.asarray(conv)
         return score if k > 1 else score[:, 0]
+
+    # ------------------------------------------------------------------
+    def to_c_code(self, num_iteration: Optional[int] = None) -> str:
+        """Standalone C source for this model (GBDT::ModelToIfElse,
+        gbdt_model_text.cpp:124 analog; CLI ``task=convert_model``)."""
+        from .codegen import model_to_c
+        return model_to_c(self, num_iteration=num_iteration)
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",

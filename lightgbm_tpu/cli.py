@@ -44,6 +44,8 @@ def run(argv: List[str]) -> int:
         return _task_refit(cfg, params)
     if task == "save_binary":
         return _task_save_binary(cfg, params)
+    if task == "convert_model":
+        return _task_convert_model(cfg, params)
     print(f"Unknown task: {task}", file=sys.stderr)
     return 1
 
@@ -113,6 +115,21 @@ def _task_save_binary(cfg: Config, params: Dict) -> int:
     out = cfg.data + ".bin.npz"
     ds.save_binary(out)
     print(f"Saved binary dataset to {out}")
+    return 0
+
+
+def _task_convert_model(cfg: Config, params: Dict) -> int:
+    """``task=convert_model`` (application.cpp ConvertModel,
+    gbdt_model_text.cpp:124 ModelToIfElse): model file -> standalone C."""
+    lang = (cfg.convert_model_language or "c").lower()
+    if lang not in ("c", "cpp"):  # the emitted C compiles as C++ too
+        raise ValueError(f"convert_model_language={lang!r} not supported "
+                         "(use 'c' or 'cpp')")
+    booster = Booster(model_file=cfg.input_model)
+    out = cfg.convert_model
+    with open(out, "w") as f:
+        f.write(booster.to_c_code())
+    print(f"Converted model saved to {out}")
     return 0
 
 

@@ -173,6 +173,8 @@ _PARAMS: Dict[str, tuple] = {
                            "test_data_file", "valid_filenames"]),
     "input_model": (str, "", ["model_input", "model_in"]),
     "output_model": (str, "LightGBM_model.txt", ["model_output", "model_out"]),
+    "convert_model": (str, "gbdt_prediction.c", ["convert_model_file"]),
+    "convert_model_language": (str, "c", []),
     "saved_feature_importance_type": (int, 0, []),
     "snapshot_freq": (int, -1, ["save_period"]),
     "output_result": (str, "LightGBM_predict_result.txt",

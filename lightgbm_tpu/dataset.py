@@ -83,10 +83,45 @@ class Metadata:
         return len(self.query_boundaries) - 1
 
 
+def _is_scipy_sparse(data) -> bool:
+    return hasattr(data, "tocsc") and hasattr(data, "nnz")
+
+
+class Sequence:
+    """Generic batched row-access object for out-of-core construction
+    (basic.py:621 ``Sequence`` analog).
+
+    Subclasses implement ``__getitem__`` (int -> 1-D row; slice/list ->
+    2-D rows) and ``__len__``.  ``batch_size`` controls how many rows are
+    materialized at a time while binning.
+    """
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            "Sub-classes of lightgbm_tpu.Sequence must implement __getitem__()")
+
+    def __len__(self) -> int:
+        raise NotImplementedError(
+            "Sub-classes of lightgbm_tpu.Sequence must implement __len__()")
+
+
+def _is_seq_input(data) -> bool:
+    if isinstance(data, Sequence):
+        return True
+    return (isinstance(data, (list, tuple)) and len(data) > 0
+            and all(isinstance(s, Sequence) for s in data))
+
+
 def _to_numpy_2d(data) -> tuple:
-    """Accept numpy / pandas / list-of-lists; return (float64 2-D array, names, cat_cols)."""
+    """Accept numpy / pandas / scipy-sparse / list-of-lists; return
+    (float64 2-D array, names, cat_cols)."""
     feature_names = None
     pandas_categorical: List[int] = []
+    if _is_scipy_sparse(data):  # CSR/CSC/COO... (LGBM_*FromCSR/CSC analog)
+        arr = np.asarray(data.todense(), dtype=np.float64)
+        return np.ascontiguousarray(arr), None, []
     if hasattr(data, "values") and hasattr(data, "columns"):  # pandas DataFrame
         feature_names = [str(c) for c in data.columns]
         cols = []
@@ -118,7 +153,8 @@ class Dataset:
                  categorical_feature: Union[str, List] = "auto",
                  reference: Optional["Dataset"] = None,
                  params: Optional[Dict[str, Any]] = None,
-                 free_raw_data: bool = False):
+                 free_raw_data: bool = False,
+                 bin_mappers: Optional[List["BinMapper"]] = None):
         self._raw_input = data
         self._label_in, self._weight_in = label, weight
         self._group_in, self._init_score_in = group, init_score
@@ -127,6 +163,9 @@ class Dataset:
         self.reference = reference
         self.params: Dict[str, Any] = dict(params or {})
         self.free_raw_data = free_raw_data
+        # externally-fitted mappers (distributed binning,
+        # parallel/dist_data.py — dataset_loader.cpp:1104-1186 analog)
+        self._preset_mappers = bin_mappers
 
         self._constructed = False
         # filled by construct():
@@ -147,8 +186,69 @@ class Dataset:
         if self._constructed:
             return self
         cfg = config or Config(self.params)
-        arr, names, pandas_cat = _to_numpy_2d(self._raw_input)
-        self.num_data, self.num_total_features = arr.shape
+        if _is_seq_input(self._raw_input):
+            return self._construct_from_seqs(cfg)
+        sparse_in = _is_scipy_sparse(self._raw_input)
+        if sparse_in:
+            # CSR/CSC input (LGBM_DatasetCreateFromCSR/CSC, c_api.h:109-313
+            # analog): bin column-at-a-time off the CSC layout — the only
+            # dense product is the packed uint8 binned matrix.
+            csc = self._raw_input.tocsc()
+            names, pandas_cat = None, []
+            self.num_data, self.num_total_features = csc.shape
+
+            def colfn(f: int) -> np.ndarray:
+                out = np.zeros(self.num_data, np.float64)
+                lo, hi = csc.indptr[f], csc.indptr[f + 1]
+                out[csc.indices[lo:hi]] = csc.data[lo:hi]
+                return out
+
+            arr = None
+        else:
+            arr, names, pandas_cat = _to_numpy_2d(self._raw_input)
+            self.num_data, self.num_total_features = arr.shape
+
+            def colfn(f: int) -> np.ndarray:
+                return arr[:, f]
+        self._set_metadata_inputs()
+        self._resolve_names(names)
+        cat_idx = self._resolve_cats(cfg, pandas_cat)
+
+        if self._preset_mappers is not None:
+            self.bin_mappers = list(self._preset_mappers)
+            self._finalize_mappers()
+        elif self.reference is not None:
+            # validation set: reuse the training set's bin mappers
+            # (Dataset::CreateValid, dataset.cpp)
+            ref = self.reference.construct(config)
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.bin_offsets = ref.bin_offsets
+            self.max_bin = ref.max_bin
+            self.efb = ref.efb
+        else:
+            self._fit_bin_mappers(colfn, cfg, cat_idx)
+
+        self._bin_data(colfn)
+        keep_raw = (not self.free_raw_data) or bool(cfg.linear_tree)
+        if sparse_in:
+            if cfg.linear_tree and self.num_total_features:
+                # linear trees need dense raw values (dataset.h:836 raw_data_)
+                self.raw_data = np.column_stack(
+                    [colfn(f) for f in range(self.num_total_features)])
+            elif keep_raw:
+                # keep the sparse matrix itself: predict() accepts CSR, so
+                # init_model / refit paths keep working without densifying
+                self.raw_data = csc.tocsr()
+            else:
+                self.raw_data = None
+        else:
+            self.raw_data = arr if keep_raw else None
+        self._constructed = True
+        self._raw_input = None
+        return self
+
+    def _set_metadata_inputs(self) -> None:
         self.metadata = Metadata(self.num_data)
         if self._label_in is not None:
             self.metadata.set_label(self._label_in)
@@ -156,6 +256,7 @@ class Dataset:
         self.metadata.set_group(self._group_in)
         self.metadata.set_init_score(self._init_score_in)
 
+    def _resolve_names(self, names) -> None:
         if self._feature_name_in != "auto" and self._feature_name_in is not None:
             self.feature_names = list(self._feature_name_in)
         elif names is not None:
@@ -163,6 +264,7 @@ class Dataset:
         else:
             self.feature_names = [f"Column_{i}" for i in range(self.num_total_features)]
 
+    def _resolve_cats(self, cfg: Config, pandas_cat) -> set:
         cat_idx = set(pandas_cat)
         if self._categorical_in != "auto" and self._categorical_in is not None:
             for c in self._categorical_in:
@@ -176,60 +278,113 @@ class Dataset:
                 tok = tok.strip()
                 if tok:
                     cat_idx.add(int(tok))
+        return cat_idx
+
+    def _construct_from_seqs(self, cfg: Config) -> "Dataset":
+        """Out-of-core construction from ``Sequence`` objects
+        (basic.py:1574 ``__init_from_seqs``): sample rows for bin-mapper
+        fitting, then bin batch-by-batch — the full raw matrix is never
+        materialized."""
+        seqs = ([self._raw_input] if isinstance(self._raw_input, Sequence)
+                else list(self._raw_input))
+        lens = [len(s) for s in seqs]
+        self.num_data = int(sum(lens))
+        probe = np.asarray(seqs[0][0], dtype=np.float64).reshape(-1)
+        self.num_total_features = probe.shape[0]
+        self._set_metadata_inputs()
+        self._resolve_names(None)
+        cat_idx = self._resolve_cats(cfg, [])
 
         if self.reference is not None:
-            # validation set: reuse the training set's bin mappers
-            # (Dataset::CreateValid, dataset.cpp)
-            ref = self.reference.construct(config)
+            ref = self.reference.construct(cfg)
             self.bin_mappers = ref.bin_mappers
             self.used_features = ref.used_features
             self.bin_offsets = ref.bin_offsets
             self.max_bin = ref.max_bin
             self.efb = ref.efb
         else:
-            self._fit_bin_mappers(arr, cfg, cat_idx)
+            sample_cnt = min(self.num_data, int(cfg.bin_construct_sample_cnt))
+            rng = np.random.RandomState(cfg.data_random_seed)
+            gidx = np.sort(rng.choice(self.num_data, size=sample_cnt,
+                                      replace=False))
+            bounds = np.concatenate([[0], np.cumsum(lens)])
+            rows = []
+            for si, s in enumerate(seqs):
+                loc = gidx[(gidx >= bounds[si]) & (gidx < bounds[si + 1])] \
+                    - bounds[si]
+                if len(loc) == 0:
+                    continue
+                try:  # list indexing is optional in the Sequence protocol
+                    rows.append(np.asarray(s[list(loc)], dtype=np.float64))
+                except (TypeError, IndexError):
+                    rows.append(np.asarray([s[int(i)] for i in loc],
+                                           dtype=np.float64))
+            sample = np.vstack(rows)
+            # EFB bundling needs whole-column access; fresh streaming input
+            # stays un-bundled (do_bundle=False skips the conflict-graph work)
+            self._fit_bin_mappers(lambda f: sample[:, f], cfg, cat_idx,
+                                  n=len(sample), do_bundle=False)
 
-        self._bin_data(arr)
-        keep_raw = (not self.free_raw_data) or bool(cfg.linear_tree)
-        self.raw_data = arr if keep_raw else None
+        dtype = np.uint8 if self.max_bin <= 256 else np.uint16
+        nf = len(self.used_features)
+        out = np.zeros((self.num_data, max(nf, 1)), dtype=dtype)
+        row = 0
+        for s in seqs:
+            bs = int(getattr(s, "batch_size", None) or Sequence.batch_size)
+            for i in range(0, len(s), bs):
+                chunk = np.atleast_2d(np.asarray(s[i:min(i + bs, len(s))],
+                                                 dtype=np.float64))
+                for j, f in enumerate(self.used_features):
+                    out[row:row + len(chunk), j] = \
+                        self.bin_mappers[f].value_to_bin(chunk[:, f]).astype(dtype)
+                row += len(chunk)
+        if self.efb is not None:
+            # a bundled reference set: regroup the per-feature bins into the
+            # EFB-grouped layout consumers read (models/gbdt.py)
+            self.binned = bin_grouped(lambda j: out[:, j].astype(np.int64),
+                                      self.efb, self.num_data)
+        else:
+            self.binned = out
+        if cfg.linear_tree:
+            raise ValueError("linear_tree requires in-memory raw data; "
+                             "Sequence input is streaming-only")
+        self.raw_data = None
         self._constructed = True
         self._raw_input = None
         return self
 
-    def _fit_bin_mappers(self, arr: np.ndarray, cfg: Config, cat_idx: set) -> None:
-        n = self.num_data
+    def _fit_bin_mappers(self, colfn, cfg: Config, cat_idx: set,
+                         n: Optional[int] = None,
+                         do_bundle: bool = True) -> None:
+        n = self.num_data if n is None else n
         sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
         # deterministic sampled rows (SampleTextDataFromFile analog,
         # dataset_loader.cpp:961) via data_random_seed
         if sample_cnt < n:
             rng = np.random.RandomState(cfg.data_random_seed)
             sample_rows = np.sort(rng.choice(n, size=sample_cnt, replace=False))
-            sample = arr[sample_rows]
+            sample_col = lambda f: colfn(f)[sample_rows]  # noqa: E731
         else:
-            sample = arr
+            sample_col = colfn
         max_bin_by_feature = cfg.max_bin_by_feature
         self.bin_mappers = []
         for f in range(self.num_total_features):
             m = BinMapper()
             mb = int(max_bin_by_feature[f]) if max_bin_by_feature else cfg.max_bin
             bt = BinType.CATEGORICAL if f in cat_idx else BinType.NUMERICAL
-            m.find_bin(sample[:, f], sample_cnt, mb, cfg.min_data_in_bin,
+            m.find_bin(sample_col(f), sample_cnt, mb, cfg.min_data_in_bin,
                        min_split_data=cfg.min_data_in_leaf,
                        pre_filter=cfg.feature_pre_filter, bin_type=bt,
                        use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing)
             self.bin_mappers.append(m)
-        self.used_features = [f for f in range(self.num_total_features)
-                              if not self.bin_mappers[f].is_trivial]
-        nbins = [self.bin_mappers[f].num_bin for f in self.used_features]
-        self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
-        self.max_bin = max([2] + nbins)
+        self._finalize_mappers()
 
-        if cfg.enable_bundle and len(self.used_features) > 1:
+        if do_bundle and cfg.enable_bundle and len(self.used_features) > 1:
             # EFB over the fitting sample (FastFeatureBundling,
             # dataset.cpp:239; see efb.py)
             mappers = [self.bin_mappers[f] for f in self.used_features]
             sample_bins = np.column_stack(
-                [m.value_to_bin(sample[:, f]) for m, f
+                [m.value_to_bin(sample_col(f)) for m, f
                  in zip(mappers, self.used_features)])
             efb = find_bundles(
                 sample_bins,
@@ -240,18 +395,25 @@ class Dataset:
                 max_conflict_rate=cfg.max_conflict_rate)
             self.efb = efb if efb.any_bundled else None
 
-    def _bin_data(self, arr: np.ndarray) -> None:
+    def _finalize_mappers(self) -> None:
+        self.used_features = [f for f in range(self.num_total_features)
+                              if not self.bin_mappers[f].is_trivial]
+        nbins = [self.bin_mappers[f].num_bin for f in self.used_features]
+        self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
+        self.max_bin = max([2] + nbins)
+
+    def _bin_data(self, colfn) -> None:
         nf = len(self.used_features)
         if self.efb is not None:
             self.binned = bin_grouped(
                 lambda j: self.bin_mappers[self.used_features[j]]
-                .value_to_bin(arr[:, self.used_features[j]]),
+                .value_to_bin(colfn(self.used_features[j])),
                 self.efb, self.num_data)
             return
         dtype = np.uint8 if self.max_bin <= 256 else np.uint16
         out = np.zeros((self.num_data, max(nf, 1)), dtype=dtype)
         for j, f in enumerate(self.used_features):
-            out[:, j] = self.bin_mappers[f].value_to_bin(arr[:, f]).astype(dtype)
+            out[:, j] = self.bin_mappers[f].value_to_bin(colfn(f)).astype(dtype)
         self.binned = out
 
     def feature_binned(self) -> np.ndarray:
@@ -378,7 +540,7 @@ class Dataset:
             payload["query_boundaries"] = self.metadata.query_boundaries
         if self.metadata.init_score is not None:
             payload["init_score"] = self.metadata.init_score
-        if self.raw_data is not None:
+        if isinstance(self.raw_data, np.ndarray):
             payload["raw_data"] = self.raw_data
         if self.efb is not None:
             payload["efb_group_of_feat"] = self.efb.group_of_feat

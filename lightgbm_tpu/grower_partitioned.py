@@ -167,6 +167,7 @@ class PartitionedGrower:
     def __init__(self, *, num_leaves: int, num_bins: int, params: SplitParams,
                  max_depth: int = -1, block_rows: int = 0,
                  mono: Optional[np.ndarray] = None,
+                 mono_method: str = "basic", mono_penalty: float = 0.0,
                  interaction_allow: Optional[np.ndarray] = None,
                  bynode_frac: float = 1.0, bynode_seed: int = 0,
                  efb=None):
@@ -177,6 +178,12 @@ class PartitionedGrower:
         self.block_rows = block_rows
         self.mono = None if mono is None or not np.any(mono) else \
             jnp.asarray(mono, jnp.int32)
+        # 'basic' = midpoint range splitting (BasicLeafConstraints);
+        # 'intermediate'/'advanced' = constraints from actual opposite-subtree
+        # outputs, refreshed across the whole frontier after each split
+        # (IntermediateLeafConstraints, monotone_constraints.hpp:514)
+        self.mono_method = mono_method
+        self.mono_penalty = float(mono_penalty)
         self.interaction_allow = interaction_allow
         self.bynode_frac = bynode_frac
         self._bynode_rng = np.random.RandomState(bynode_seed)
@@ -231,6 +238,17 @@ class PartitionedGrower:
                 kw = dict(mono=self.mono,
                           out_lo=jnp.float32(leaf_lo[leaf]),
                           out_hi=jnp.float32(leaf_hi[leaf]))
+                if self.mono_penalty > 0.0:
+                    d = depth.get(leaf, 0)
+                    pen = self.mono_penalty
+                    if pen >= d + 1.0:
+                        factor = 1e-15
+                    elif pen <= 1.0:
+                        factor = 1.0 - pen / (2.0 ** d) + 1e-15
+                    else:
+                        factor = 1.0 - 2.0 ** (pen - 1.0 - d) + 1e-15
+                    kw["gain_scale"] = jnp.where(
+                        self.mono != 0, jnp.float32(factor), jnp.float32(1.0))
             if cegb_state is not None and cegb_state.active:
                 kw["gain_penalty"] = jnp.asarray(
                     cegb_state.penalty_vector(total[2]))
@@ -241,6 +259,7 @@ class PartitionedGrower:
                               parent_output=jnp.float32(pout),
                               is_cat=is_cat, **kw)
 
+        depth = {0: 0}
         hists = {0: hist0}
         cand = {0: _pull(_find_leaf(hist0, total0, root_out, 0))}
         totals = {0: total0}
@@ -249,7 +268,6 @@ class PartitionedGrower:
         # host tree state
         begins = {0: 0}
         counts = {0: n}
-        depth = {0: 0}
         leaf_parent = {0: -1}
         split_feature = np.zeros(L - 1, np.int32)
         threshold_bin = np.zeros(L - 1, np.int32)
@@ -357,7 +375,28 @@ class PartitionedGrower:
             leaf_mask[new] = child_mask
             lo_p, hi_p = leaf_lo[leaf], leaf_hi[leaf]
             mc = 0 if self.mono is None else int(np.asarray(self.mono)[rec.feature])
-            if mc != 0 and not rec.is_cat:
+            use_intermediate = (self.mono is not None
+                                and self.mono_method in ("intermediate",
+                                                         "advanced"))
+            refresh = []
+            if use_intermediate:
+                # recompute the whole frontier's intervals from the actual
+                # opposite-subtree outputs (IntermediateLeafConstraints
+                # UpdateConstraintsWithOutputs + GoUpToFindLeavesToUpdate,
+                # monotone_constraints.hpp:543-587 — here a full host-side
+                # refresh instead of the reference's up-walk bookkeeping)
+                num_leaves_next = new + 1
+                iv = self._mono_intervals(
+                    num_leaves_next, split_feature, left_child, right_child,
+                    leaf_value, is_cat_node)
+                for l in range(num_leaves_next):
+                    lo2, hi2 = iv[l]
+                    if l not in (leaf, new) and (
+                            abs(lo2 - leaf_lo.get(l, -inf)) > 1e-12
+                            or abs(hi2 - leaf_hi.get(l, inf)) > 1e-12):
+                        refresh.append(l)
+                    leaf_lo[l], leaf_hi[l] = lo2, hi2
+            elif mc != 0 and not rec.is_cat:
                 mid = 0.5 * (rec.left_output + rec.right_output)
                 if mc > 0:   # left (smaller values) must output <= right
                     leaf_lo[leaf], leaf_hi[leaf] = lo_p, min(hi_p, mid)
@@ -373,6 +412,9 @@ class PartitionedGrower:
             r_r = _find_leaf(hists[new], totals[new], parent_out[new], new)
             cand[leaf] = _pull(r_l)
             cand[new] = _pull(r_r)
+            for l in refresh:   # constraint drift -> re-search those leaves
+                cand[l] = _pull(_find_leaf(hists[l], totals[l],
+                                           parent_out[l], l))
             num_leaves = new + 1
             order_box[0] = order
 
@@ -436,6 +478,54 @@ class PartitionedGrower:
             is_cat_node=jnp.asarray(is_cat_node),
             cat_rank=jnp.asarray(cat_rank),
         )
+
+    def _mono_intervals(self, num_leaves, split_feature, left_child,
+                        right_child, leaf_value, is_cat_node):
+        """Per-leaf allowed output intervals from the current tree shape
+        ('intermediate' method): walking root->leaf, a monotone split bounds
+        the leaf by the extremum of the *opposite* subtree's current leaf
+        outputs (tighter than the 'basic' midpoint; the analog of
+        IntermediateLeafConstraints keeping constraints equal to actual
+        sibling outputs, monotone_constraints.hpp:543-556)."""
+        inf = float(np.finfo(np.float32).max)
+        mono_np = np.asarray(self.mono)
+        iv = {l: (-inf, inf) for l in range(num_leaves)}
+        if num_leaves <= 1:
+            return iv
+        minmax_cache = {}
+
+        def subtree_minmax(child):
+            if child in minmax_cache:
+                return minmax_cache[child]
+            if child < 0:
+                v = float(leaf_value[~child])
+                r = (v, v)
+            else:
+                l0, l1 = subtree_minmax(int(left_child[child]))
+                r0, r1 = subtree_minmax(int(right_child[child]))
+                r = (min(l0, r0), max(l1, r1))
+            minmax_cache[child] = r
+            return r
+
+        stack = [(0, -inf, inf)]
+        while stack:
+            node, lo, hi = stack.pop()
+            lc, rc = int(left_child[node]), int(right_child[node])
+            mc = 0 if is_cat_node[node] else \
+                int(mono_np[int(split_feature[node])])
+            llo, lhi, rlo, rhi = lo, hi, lo, hi
+            if mc > 0:
+                lhi = min(lhi, subtree_minmax(rc)[0])
+                rlo = max(rlo, subtree_minmax(lc)[1])
+            elif mc < 0:
+                llo = max(llo, subtree_minmax(rc)[1])
+                rhi = min(rhi, subtree_minmax(lc)[0])
+            for child, clo, chi in ((lc, llo, lhi), (rc, rlo, rhi)):
+                if child < 0:
+                    iv[~child] = (clo, chi)
+                else:
+                    stack.append((child, clo, chi))
+        return iv
 
     def _forced_record(self, spec, hist, total, pout, B) -> Optional[_HostSplit]:
         """Build a split record for a forced (feature, threshold) node

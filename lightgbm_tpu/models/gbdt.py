@@ -139,6 +139,8 @@ class GBDTModel:
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
                 block_rows=config.rows_per_block, mono=mono,
+                mono_method=config.monotone_constraints_method,
+                mono_penalty=config.monotone_penalty,
                 interaction_allow=inter,
                 bynode_frac=config.feature_fraction_bynode,
                 bynode_seed=config.feature_fraction_seed + 1,

@@ -313,12 +313,21 @@ const char* LGBM_GetLastError() { return g_last_error.c_str(); }
 int LGBM_BoosterLoadModelFromString(const char* model_str,
                                     int* out_num_iterations,
                                     BoosterHandle* out) {
-  std::string err;
-  Booster* b = Booster::FromString(model_str, &err);
-  if (!b) return SetError(err);
-  if (out_num_iterations) *out_num_iterations = b->NumIterations();
-  *out = b;
-  return 0;
+  // malformed numeric fields (std::stoi/stod) must not let exceptions
+  // escape the C ABI: report through LGBM_GetLastError like every other
+  // failure path
+  try {
+    std::string err;
+    Booster* b = Booster::FromString(model_str, &err);
+    if (!b) return SetError(err);
+    if (out_num_iterations) *out_num_iterations = b->NumIterations();
+    *out = b;
+    return 0;
+  } catch (const std::exception& e) {
+    return SetError(std::string("model parse error: ") + e.what());
+  } catch (...) {
+    return SetError("model parse error");
+  }
 }
 
 int LGBM_BoosterCreateFromModelfile(const char* filename,

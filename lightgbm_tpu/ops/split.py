@@ -245,7 +245,8 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
                     params: SplitParams, parent_output: jax.Array = None,
                     is_cat: jax.Array = None, mono: jax.Array = None,
                     out_lo: jax.Array = None, out_hi: jax.Array = None,
-                    gain_penalty: jax.Array = None) -> SplitResult:
+                    gain_penalty: jax.Array = None,
+                    gain_scale: jax.Array = None) -> SplitResult:
     """Best split for one leaf across numerical and categorical features.
 
     hist:         [F, B, 3] f32 — per-feature histograms (g, h, count)
@@ -267,6 +268,12 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
     if mono is not None:
         ngains = _monotone_adjust(ngains, nlefts, total, mono, out_lo, out_hi,
                                   0, params, parent_out)
+    if gain_scale is not None:
+        # monotone_penalty: depth-scaled multiplicative penalty on splits of
+        # monotone features (ComputeMonotoneSplitGainPenalty,
+        # monotone_constraints.hpp:355; applied serial_tree_learner.cpp:779)
+        ngains = jnp.where(ngains > kMinScore,
+                           ngains * gain_scale[None, :, None], ngains)
     if gain_penalty is not None:
         # CEGB per-feature acquisition penalty subtracted from candidate
         # gains (cost_effective_gradient_boosting.hpp:70-78 DeltaGain)

@@ -105,3 +105,12 @@ def test_linear_tree_parity(tmp_path):
 def test_error_on_bad_model():
     with pytest.raises(RuntimeError):
         NativeBooster(model_str="this is not a model")
+
+
+def test_error_on_corrupt_numeric_field():
+    """std::stoi failures must surface as errors, not abort the process
+    (exception must not escape the C ABI)."""
+    bad = ("num_class=1\nnum_tree_per_iteration=1\nmax_feature_idx=0\n"
+           "Tree=0\nnum_leaves=abc\n")
+    with pytest.raises(RuntimeError):
+        NativeBooster(model_str=bad)

@@ -47,6 +47,38 @@ class TestMonotone:
         mse = np.mean((bst.predict(x) - y) ** 2)
         assert mse < 0.5 * np.var(y)
 
+    def test_intermediate_method(self):
+        """'intermediate' (IntermediateLeafConstraints,
+        monotone_constraints.hpp:514): still monotone, and at least as good
+        a fit as 'basic' (it is strictly less conservative)."""
+        x, y = _mono_data(seed=5)
+        base = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+                "min_data_in_leaf": 10, "monotone_constraints": [1, -1, 0]}
+        bst_i = lgb.train({**base, "monotone_constraints_method": "intermediate"},
+                          lgb.Dataset(x, label=y), num_boost_round=30)
+        assert _check_monotone(bst_i, 0, +1)
+        assert _check_monotone(bst_i, 1, -1)
+        bst_b = lgb.train({**base, "monotone_constraints_method": "basic"},
+                          lgb.Dataset(x, label=y), num_boost_round=30)
+        mse_i = np.mean((bst_i.predict(x) - y) ** 2)
+        mse_b = np.mean((bst_b.predict(x) - y) ** 2)
+        assert mse_i <= mse_b * 1.05, (mse_i, mse_b)
+
+    def test_monotone_penalty(self):
+        """monotone_penalty discourages monotone-feature splits near the
+        root (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355)."""
+        x, y = _mono_data(seed=7)
+        base = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+                "min_data_in_leaf": 10, "monotone_constraints": [1, -1, 0]}
+        bst = lgb.train({**base, "monotone_penalty": 2.0},
+                        lgb.Dataset(x, label=y), num_boost_round=10)
+        assert _check_monotone(bst, 0, +1)
+        # with a large penalty, depth-0/1 splits should avoid monotone feats
+        for t in bst.trees:
+            if t.num_nodes() > 0:
+                assert int(t.split_feature[0]) == 2, \
+                    f"root split used monotone feature {t.split_feature[0]}"
+
     def test_unconstrained_violates(self):
         # sanity: without constraints the sweep check fails (data is noisy)
         x, y = _mono_data(seed=3)
