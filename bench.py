@@ -31,6 +31,12 @@ def main():
     iters = 100
     x, y = make_higgs_like(n, f)
 
+    print("[bench] data ready; importing jax / claiming device...",
+          file=sys.stderr, flush=True)
+    t_dev = time.time()
+    import jax
+    print(f"[bench] devices={jax.devices()} ({time.time() - t_dev:.1f}s)",
+          file=sys.stderr, flush=True)
     import lightgbm_tpu as lgb
     from lightgbm_tpu.metrics import _auc
 

@@ -437,6 +437,9 @@ class Booster:
     # ------------------------------------------------------------------
     def _load_model_string(self, s: str) -> None:
         """LoadModelFromString (gbdt_model_text.cpp:421)."""
+        if "num_class=" not in s:
+            raise ValueError("input is not a lightgbm_tpu model "
+                             "(missing header)")
         header, _, rest = s.partition("\nTree=")
         kv: Dict[str, str] = {}
         for line in header.splitlines():

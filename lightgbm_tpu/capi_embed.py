@@ -1,0 +1,149 @@
+"""Embedding bridge for the native training C API.
+
+The reference exposes its full training surface through C
+(/root/reference/src/c_api.cpp: LGBM_DatasetCreateFromMat :~900,
+LGBM_BoosterCreate :1600, LGBM_BoosterUpdateOneIter :1686,
+LGBM_BoosterSaveModel...).  In the TPU rebuild the training core is a JAX
+program, so the native shim (native/capi_train.cpp) embeds CPython and
+calls these thin adapters; zero-copy views of the caller's buffers come in
+as memoryviews.
+
+Functions here must stay exception-safe-by-contract: the C++ caller
+converts any raised exception into LGBM_GetLastError().
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+if os.environ.get("LGBM_TPU_FORCE_CPU"):
+    # embedded hosts (pure-C callers) can't run the test conftest; honor an
+    # env switch so they avoid claiming the exclusive TPU tunnel
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+from .booster import Booster
+from .config import kv2map
+from .dataset import Dataset
+
+_F32, _F64, _I32, _I64 = 0, 1, 2, 3
+_NP_OF = {_F32: np.float32, _F64: np.float64, _I32: np.int32, _I64: np.int64}
+
+
+def _params(s: str) -> dict:
+    return kv2map((s or "").replace("\n", " ").split())
+
+
+def dataset_create_from_mat(mv, nrow: int, ncol: int, params: str,
+                            reference: Optional[Dataset] = None) -> Dataset:
+    arr = np.frombuffer(mv, np.float64).reshape(int(nrow), int(ncol)).copy()
+    return Dataset(arr, params=_params(params), reference=reference)
+
+
+def dataset_create_from_file(path: str, params: str,
+                             reference: Optional[Dataset] = None) -> Dataset:
+    from .data_io import load_text
+    p = _params(params)
+    x, y = load_text(path, has_header=str(p.get("header", "")).lower()
+                     in ("true", "1"),
+                     label_column=str(p.get("label_column", "")))
+    return Dataset(x, label=y, params=p, reference=reference)
+
+
+def dataset_set_field(ds: Dataset, name: str, mv, n: int, dtype: int) -> None:
+    arr = np.frombuffer(mv, _NP_OF[int(dtype)])[:int(n)].copy()
+    if name == "label":
+        ds.set_label(arr)
+    elif name == "weight":
+        ds.set_weight(arr)
+    elif name in ("group", "query"):
+        ds.set_group(arr)
+    elif name == "init_score":
+        ds.set_init_score(arr)
+    else:
+        raise ValueError(f"unknown field {name!r}")
+
+
+def dataset_num_data(ds: Dataset) -> int:
+    ds.construct()
+    return int(ds.num_data)
+
+
+def dataset_num_feature(ds: Dataset) -> int:
+    ds.construct()
+    return int(ds.num_total_features)
+
+
+def booster_create(ds: Dataset, params: str) -> Booster:
+    return Booster(params=_params(params), train_set=ds)
+
+
+def booster_create_from_model_string(s: str) -> Booster:
+    return Booster(model_str=s)
+
+
+def booster_add_valid(bst: Booster, ds: Dataset, name: str) -> None:
+    bst.add_valid(ds, name)
+
+
+def booster_update(bst: Booster) -> int:
+    return 1 if bst.update() else 0
+
+
+def booster_rollback(bst: Booster) -> None:
+    bst.rollback_one_iter()
+
+
+def booster_current_iteration(bst: Booster) -> int:
+    return int(bst.current_iteration)
+
+
+def booster_num_classes(bst: Booster) -> int:
+    return int(bst._num_class)
+
+
+def booster_save_model_to_string(bst: Booster, start_iteration: int,
+                                 num_iteration: int) -> str:
+    num = num_iteration if num_iteration > 0 else None
+    return bst.model_to_string(num_iteration=num,
+                               start_iteration=int(start_iteration))
+
+
+def booster_save_model(bst: Booster, start_iteration: int,
+                       num_iteration: int, filename: str) -> None:
+    with open(filename, "w") as f:
+        f.write(booster_save_model_to_string(bst, start_iteration,
+                                             num_iteration))
+
+
+def booster_get_eval(bst: Booster) -> str:
+    """One eval sweep, rendered as 'name metric value' lines."""
+    rows = bst.eval_valid() + bst.eval_train()
+    return "\n".join(f"{dn}\t{mn}\t{val!r}" for dn, mn, val, _ in rows)
+
+
+def booster_predict_mat(bst: Booster, mv, nrow: int, ncol: int,
+                        predict_type: int, start_iteration: int,
+                        num_iteration: int, out_mv) -> int:
+    """predict_type: 0 normal, 1 raw, 2 leaf index, 3 contrib
+    (C_API_PREDICT_* values, c_api.h:527-535)."""
+    x = np.frombuffer(mv, np.float64).reshape(int(nrow), int(ncol))
+    num = num_iteration if num_iteration > 0 else None
+    kw = dict(start_iteration=int(start_iteration), num_iteration=num)
+    if predict_type == 2:
+        res = bst.predict(x, pred_leaf=True, **kw).astype(np.float64)
+    elif predict_type == 3:
+        res = bst.predict(x, pred_contrib=True, **kw).astype(np.float64)
+    else:
+        res = bst.predict(x, raw_score=(predict_type == 1),
+                          **kw).astype(np.float64)
+    flat = np.ascontiguousarray(res).reshape(-1)
+    out = np.frombuffer(out_mv, np.float64)
+    if len(flat) > len(out):
+        raise ValueError(f"output buffer too small: need {len(flat)}, "
+                         f"have {len(out)}")
+    out[:len(flat)] = flat
+    return int(len(flat))

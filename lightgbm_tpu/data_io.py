@@ -17,6 +17,17 @@ import numpy as np
 from .native import native_parse_csv
 
 
+_PARSER_REGISTRY = {}
+
+
+def register_parser(name: str, fn) -> None:
+    """Pluggable custom parsers (``ParserFactory`` analog, parser.hpp:93 /
+    dataset.h:304 ``CreateParser``): ``fn(path, has_header, label_column)``
+    -> (features [N, F], label [N] or None).  Select with
+    ``load_text(..., fmt=name)`` or the ``parser`` config key."""
+    _PARSER_REGISTRY[name] = fn
+
+
 def detect_format(path: str, has_header: bool = False) -> str:
     """Sniff csv/tsv/libsvm from the first data line (parser.cpp
     auto-detect analog)."""
@@ -47,6 +58,8 @@ def load_text(path: str, has_header: bool = False,
     dataset_loader.cpp label_idx_=0).
     """
     fmt = fmt or detect_format(path, has_header)
+    if fmt in _PARSER_REGISTRY:
+        return _PARSER_REGISTRY[fmt](path, has_header, label_column)
     if fmt == "libsvm":
         return _load_libsvm(path)
     delim = "\t" if fmt == "tsv" else ","

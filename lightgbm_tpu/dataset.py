@@ -87,6 +87,15 @@ def _is_scipy_sparse(data) -> bool:
     return hasattr(data, "tocsc") and hasattr(data, "nnz")
 
 
+def _sample_rows(rng, n: int, cnt: int) -> np.ndarray:
+    """~cnt sorted unique row indices in O(cnt) memory (choice without
+    replacement would build an O(n) permutation — fatal for out-of-core n)."""
+    if cnt >= n:
+        return np.arange(n, dtype=np.int64)
+    draw = rng.randint(0, n, size=int(cnt * 1.1) + 16).astype(np.int64)
+    return np.unique(draw)[:cnt]
+
+
 class Sequence:
     """Generic batched row-access object for out-of-core construction
     (basic.py:621 ``Sequence`` analog).
@@ -203,6 +212,21 @@ class Dataset:
                 out[csc.indices[lo:hi]] = csc.data[lo:hi]
                 return out
 
+            def sample_col_factory(rows: np.ndarray):
+                # O(nnz_col)-per-column sampled access straight off the CSC
+                # layout — no N-length dense intermediate
+                def col(f: int) -> np.ndarray:
+                    lo, hi = csc.indptr[f], csc.indptr[f + 1]
+                    idx, dat = csc.indices[lo:hi], csc.data[lo:hi]
+                    out = np.zeros(len(rows), np.float64)
+                    if len(idx):
+                        pos = np.minimum(np.searchsorted(idx, rows),
+                                         len(idx) - 1)
+                        hit = idx[pos] == rows
+                        out[hit] = dat[pos[hit]]
+                    return out
+                return col
+
             arr = None
         else:
             arr, names, pandas_cat = _to_numpy_2d(self._raw_input)
@@ -210,6 +234,8 @@ class Dataset:
 
             def colfn(f: int) -> np.ndarray:
                 return arr[:, f]
+
+            sample_col_factory = None
         self._set_metadata_inputs()
         self._resolve_names(names)
         cat_idx = self._resolve_cats(cfg, pandas_cat)
@@ -227,7 +253,8 @@ class Dataset:
             self.max_bin = ref.max_bin
             self.efb = ref.efb
         else:
-            self._fit_bin_mappers(colfn, cfg, cat_idx)
+            self._fit_bin_mappers(colfn, cfg, cat_idx,
+                                  sample_col_factory=sample_col_factory)
 
         self._bin_data(colfn)
         keep_raw = (not self.free_raw_data) or bool(cfg.linear_tree)
@@ -285,6 +312,9 @@ class Dataset:
         (basic.py:1574 ``__init_from_seqs``): sample rows for bin-mapper
         fitting, then bin batch-by-batch — the full raw matrix is never
         materialized."""
+        if cfg.linear_tree:
+            raise ValueError("linear_tree requires in-memory raw data; "
+                             "Sequence input is streaming-only")
         seqs = ([self._raw_input] if isinstance(self._raw_input, Sequence)
                 else list(self._raw_input))
         lens = [len(s) for s in seqs]
@@ -295,7 +325,12 @@ class Dataset:
         self._resolve_names(None)
         cat_idx = self._resolve_cats(cfg, [])
 
-        if self.reference is not None:
+        if self._preset_mappers is not None:
+            # distributed binning handoff (parallel/dist_data.py) works for
+            # streaming input too
+            self.bin_mappers = list(self._preset_mappers)
+            self._finalize_mappers()
+        elif self.reference is not None:
             ref = self.reference.construct(cfg)
             self.bin_mappers = ref.bin_mappers
             self.used_features = ref.used_features
@@ -305,8 +340,7 @@ class Dataset:
         else:
             sample_cnt = min(self.num_data, int(cfg.bin_construct_sample_cnt))
             rng = np.random.RandomState(cfg.data_random_seed)
-            gidx = np.sort(rng.choice(self.num_data, size=sample_cnt,
-                                      replace=False))
+            gidx = _sample_rows(rng, self.num_data, sample_cnt)
             bounds = np.concatenate([[0], np.cumsum(lens)])
             rows = []
             for si, s in enumerate(seqs):
@@ -345,9 +379,6 @@ class Dataset:
                                       self.efb, self.num_data)
         else:
             self.binned = out
-        if cfg.linear_tree:
-            raise ValueError("linear_tree requires in-memory raw data; "
-                             "Sequence input is streaming-only")
         self.raw_data = None
         self._constructed = True
         self._raw_input = None
@@ -355,15 +386,21 @@ class Dataset:
 
     def _fit_bin_mappers(self, colfn, cfg: Config, cat_idx: set,
                          n: Optional[int] = None,
-                         do_bundle: bool = True) -> None:
+                         do_bundle: bool = True,
+                         sample_col_factory=None) -> None:
         n = self.num_data if n is None else n
         sample_cnt = min(n, int(cfg.bin_construct_sample_cnt))
         # deterministic sampled rows (SampleTextDataFromFile analog,
         # dataset_loader.cpp:961) via data_random_seed
         if sample_cnt < n:
             rng = np.random.RandomState(cfg.data_random_seed)
-            sample_rows = np.sort(rng.choice(n, size=sample_cnt, replace=False))
-            sample_col = lambda f: colfn(f)[sample_rows]  # noqa: E731
+            sample_rows = _sample_rows(rng, n, sample_cnt)
+            if sample_col_factory is not None:
+                sample_col = sample_col_factory(sample_rows)
+            else:
+                sample_col = lambda f: colfn(f)[sample_rows]  # noqa: E731
+        elif sample_col_factory is not None:
+            sample_col = sample_col_factory(np.arange(n, dtype=np.int64))
         else:
             sample_col = colfn
         max_bin_by_feature = cfg.max_bin_by_feature
@@ -542,6 +579,12 @@ class Dataset:
             payload["init_score"] = self.metadata.init_score
         if isinstance(self.raw_data, np.ndarray):
             payload["raw_data"] = self.raw_data
+        elif self.raw_data is not None and hasattr(self.raw_data, "tocsr"):
+            csr = self.raw_data.tocsr()
+            payload["raw_csr_data"] = csr.data
+            payload["raw_csr_indices"] = csr.indices
+            payload["raw_csr_indptr"] = csr.indptr
+            payload["raw_csr_shape"] = np.asarray(csr.shape, np.int64)
         if self.efb is not None:
             payload["efb_group_of_feat"] = self.efb.group_of_feat
             payload["efb_off_of_feat"] = self.efb.off_of_feat
@@ -584,7 +627,15 @@ class Dataset:
             ds.metadata.query_boundaries = z["query_boundaries"]
         if "init_score" in z.files:
             ds.metadata.init_score = z["init_score"]
-        ds.raw_data = z["raw_data"] if "raw_data" in z.files else None
+        if "raw_data" in z.files:
+            ds.raw_data = z["raw_data"]
+        elif "raw_csr_data" in z.files:
+            import scipy.sparse as _sp
+            ds.raw_data = _sp.csr_matrix(
+                (z["raw_csr_data"], z["raw_csr_indices"], z["raw_csr_indptr"]),
+                shape=tuple(z["raw_csr_shape"]))
+        else:
+            ds.raw_data = None
         ds.efb = None
         if "efb_group_of_feat" in z.files:
             sizes = z["efb_group_sizes"]

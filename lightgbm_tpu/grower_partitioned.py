@@ -170,7 +170,7 @@ class PartitionedGrower:
                  mono_method: str = "basic", mono_penalty: float = 0.0,
                  interaction_allow: Optional[np.ndarray] = None,
                  bynode_frac: float = 1.0, bynode_seed: int = 0,
-                 efb=None):
+                 efb=None, pool_entries: int = 0):
         self.L = int(num_leaves)
         self.B = int(num_bins)
         self.params = params
@@ -188,6 +188,13 @@ class PartitionedGrower:
         self.bynode_frac = bynode_frac
         self._bynode_rng = np.random.RandomState(bynode_seed)
         self._find = jax.jit(functools.partial(find_best_split, params=params))
+        # HistogramPool analog (feature_histogram.hpp:1095,
+        # histogram_pool_size): cap the number of device-resident per-leaf
+        # histograms; evicted leaves are reconstructed on demand (the
+        # reference recomputes on pool miss the same way,
+        # serial_tree_learner.cpp:283-323 slot juggling).  0 = unbounded.
+        self.pool_entries = max(2, int(pool_entries)) if pool_entries > 0 \
+            else 0
         self.efb = efb  # EFBDevice (efb.py) or None
         # histogram axis: group bins when bundled, feature bins otherwise
         self.BH = efb.group_bins if efb is not None else self.B
@@ -261,6 +268,33 @@ class PartitionedGrower:
 
         depth = {0: 0}
         hists = {0: hist0}
+        lru: List[int] = [0]
+
+        def _store(l: int, h) -> None:
+            hists[l] = h
+            if self.pool_entries <= 0:
+                return
+            if l in lru:
+                lru.remove(l)
+            lru.append(l)
+            live = [k for k in lru if hists.get(k) is not None]
+            while len(live) > self.pool_entries:
+                victim = live.pop(0)
+                hists[victim] = None
+                lru.remove(victim)
+
+        def _get_hist(l: int):
+            """Pool fetch; evicted leaves rebuilt from their row segment."""
+            h = hists.get(l)
+            if h is None:
+                p_l = min(_pow2(max(counts[l], 1)), p_full)
+                h = _hist_segment(order_box[0], binned, vals,
+                                  jnp.int32(begins[l]), jnp.int32(counts[l]),
+                                  p=p_l, num_bins=self.BH,
+                                  block_rows=self.block_rows)
+            _store(l, h)
+            return h
+
         cand = {0: _pull(_find_leaf(hist0, total0, root_out, 0))}
         totals = {0: total0}
         parent_out = {0: root_out}
@@ -352,15 +386,27 @@ class PartitionedGrower:
             leaf_depth_arr[new] = d
 
             # histogram: smaller child constructed, larger by subtraction
+            # (falls back to direct construction on a histogram-pool miss —
+            # the parent's rows are already re-partitioned by now)
             sm, lg = (leaf, new) if cl <= cr else (new, leaf)
+            parent_hist = hists.get(leaf)
             p_sm = min(_pow2(max(counts[sm], 1)), p_full)
             hist_sm = _hist_segment(order, binned, vals,
                                     jnp.int32(begins[sm]),
                                     jnp.int32(counts[sm]), p=p_sm,
                                     num_bins=self.BH,
                                     block_rows=self.block_rows)
-            hist_lg = hists[leaf] - hist_sm
-            hists[sm], hists[lg] = hist_sm, hist_lg
+            if parent_hist is not None:
+                hist_lg = parent_hist - hist_sm
+            else:
+                p_lg = min(_pow2(max(counts[lg], 1)), p_full)
+                hist_lg = _hist_segment(order, binned, vals,
+                                        jnp.int32(begins[lg]),
+                                        jnp.int32(counts[lg]), p=p_lg,
+                                        num_bins=self.BH,
+                                        block_rows=self.block_rows)
+            _store(sm, hist_sm)
+            _store(lg, hist_lg)
             totals[leaf] = rec.left_sum
             totals[new] = rec.right_sum
             parent_out[leaf] = rec.left_output
@@ -413,7 +459,7 @@ class PartitionedGrower:
             cand[leaf] = _pull(r_l)
             cand[new] = _pull(r_r)
             for l in refresh:   # constraint drift -> re-search those leaves
-                cand[l] = _pull(_find_leaf(hists[l], totals[l],
+                cand[l] = _pull(_find_leaf(_get_hist(l), totals[l],
                                            parent_out[l], l))
             num_leaves = new + 1
             order_box[0] = order
@@ -426,8 +472,9 @@ class PartitionedGrower:
             queue = [(forced, 0)]
             while queue and next_node < node_budget:
                 spec, leaf = queue.pop(0)
-                fh = hists[leaf] if self.efb is None else self._expand(
-                    hists[leaf], jnp.asarray(totals[leaf], jnp.float32))
+                ph = _get_hist(leaf)
+                fh = ph if self.efb is None else self._expand(
+                    ph, jnp.asarray(totals[leaf], jnp.float32))
                 rec = self._forced_record(spec, fh, totals[leaf],
                                           parent_out[leaf], B)
                 if rec is None:

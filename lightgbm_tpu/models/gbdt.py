@@ -144,7 +144,8 @@ class GBDTModel:
                 interaction_allow=inter,
                 bynode_frac=config.feature_fraction_bynode,
                 bynode_seed=config.feature_fraction_seed + 1,
-                efb=self.efb_dev)
+                efb=self.efb_dev,
+                pool_entries=self._pool_entries(config, ds))
         else:
             if has_node_controls:
                 raise ValueError(
@@ -305,6 +306,22 @@ class GBDTModel:
             return out
 
         return conv(spec)
+
+    def _pool_entries(self, config: Config, ds: Dataset) -> int:
+        """histogram_pool_size (MB, config.h) -> max cached per-leaf
+        histograms for the HistogramPool analog (feature_histogram.hpp:1095;
+        sizing logic mirrors serial_tree_learner.cpp:33-46)."""
+        if config.histogram_pool_size <= 0:
+            return 0
+        cols = self.efb_dev.group_bins if self.efb_dev is not None \
+            else self.max_bin
+        nf = (int(self.efb_dev.group_host.max()) + 1
+              if self.efb_dev is not None else self.num_features)
+        # grower histograms are [F, B, 3] f32; under EFB the bin axis is the
+        # max group-bin count
+        bytes_per_leaf = max(nf, 1) * max(cols, 2) * 3 * 4
+        return max(2, int(config.histogram_pool_size * 1024 * 1024
+                          / bytes_per_leaf))
 
     @staticmethod
     def _interaction_allow(config: Config, ds: Dataset):

@@ -1,0 +1,318 @@
+// Native training C API: LGBM-style entry points over the JAX core.
+//
+// The reference's C API exposes the FULL training lifecycle natively
+// (include/LightGBM/c_api.h:109-1350: dataset create, booster create,
+// update-one-iter, save/predict; src/c_api.cpp).  In the TPU rebuild the
+// training core is a JAX/XLA program that lives in Python, so this shim
+// embeds CPython (dual-mode: bootstraps an interpreter for pure-C hosts,
+// joins the existing one when loaded into a Python process) and drives
+// lightgbm_tpu.capi_embed.  External C/C++/FFI callers get the same
+// train-from-C workflow the reference offers; inference without Python
+// stays in libcapi.so.
+//
+// Build:
+//   g++ -O2 -shared -fPIC capi_train.cpp -o libcapi_train.so \
+//       $(python3-config --includes) $(python3-config --ldflags --embed)
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+int SetError(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+bool g_we_initialized = false;
+
+// Acquire the GIL, bootstrapping the interpreter for non-Python hosts.
+class Gil {
+ public:
+  Gil() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      // release the GIL the init gave us so PyGILState_Ensure below works
+      // uniformly from any thread
+      (void)PyEval_SaveThread();
+    }
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+int PyError() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return SetError(msg);
+}
+
+PyObject* Bridge() {  // borrowed-style cached module handle
+  static PyObject* mod = nullptr;
+  if (!mod) mod = PyImport_ImportModule("lightgbm_tpu.capi_embed");
+  return mod;
+}
+
+// vectorcall into the bridge; returns new ref or nullptr (error set)
+PyObject* Call(const char* fn, PyObject* args) {
+  PyObject* mod = Bridge();
+  if (!mod) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (!f) return nullptr;
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return r;
+}
+
+PyObject* View(const void* data, Py_ssize_t nbytes, bool writable = false) {
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<void*>(data)), nbytes,
+      writable ? PyBUF_WRITE : PyBUF_READ);
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+const char* LGBM_TrainGetLastError() { return g_last_error.c_str(); }
+
+int LGBM_TrainDatasetCreateFromMat(const double* data, int nrow, int ncol,
+                                   const char* parameters,
+                                   DatasetHandle reference,
+                                   DatasetHandle* out) {
+  Gil gil;
+  PyObject* mv = View(data, static_cast<Py_ssize_t>(nrow) * ncol * 8);
+  PyObject* ref = reference ? reinterpret_cast<PyObject*>(reference) : Py_None;
+  PyObject* args = Py_BuildValue("(OiisO)", mv, nrow, ncol,
+                                 parameters ? parameters : "", ref);
+  Py_DECREF(mv);
+  PyObject* r = Call("dataset_create_from_mat", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;  // ownership transferred to the handle
+  return 0;
+}
+
+int LGBM_TrainDatasetCreateFromFile(const char* filename,
+                                    const char* parameters,
+                                    DatasetHandle reference,
+                                    DatasetHandle* out) {
+  Gil gil;
+  PyObject* ref = reference ? reinterpret_cast<PyObject*>(reference) : Py_None;
+  PyObject* args = Py_BuildValue("(ssO)", filename,
+                                 parameters ? parameters : "", ref);
+  PyObject* r = Call("dataset_create_from_file", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+// field_type: 0 float32, 1 float64, 2 int32, 3 int64 (capi_embed._NP_OF)
+int LGBM_TrainDatasetSetField(DatasetHandle handle, const char* field_name,
+                              const void* field_data, int num_element,
+                              int field_type) {
+  Gil gil;
+  static const int kWidth[] = {4, 8, 4, 8};
+  if (field_type < 0 || field_type > 3) return SetError("bad field_type");
+  PyObject* mv = View(field_data,
+                      static_cast<Py_ssize_t>(num_element) * kWidth[field_type]);
+  PyObject* args = Py_BuildValue("(OsOii)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 field_name, mv, num_element, field_type);
+  Py_DECREF(mv);
+  PyObject* r = Call("dataset_set_field", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+static int GetInt(const char* fn, PyObject* obj, int* out) {
+  PyObject* args = Py_BuildValue("(O)", obj);
+  PyObject* r = Call(fn, args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_TrainDatasetGetNumData(DatasetHandle handle, int* out) {
+  Gil gil;
+  return GetInt("dataset_num_data", reinterpret_cast<PyObject*>(handle), out);
+}
+
+int LGBM_TrainDatasetGetNumFeature(DatasetHandle handle, int* out) {
+  Gil gil;
+  return GetInt("dataset_num_feature", reinterpret_cast<PyObject*>(handle),
+                out);
+}
+
+int LGBM_TrainDatasetFree(DatasetHandle handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+int LGBM_TrainBoosterCreate(DatasetHandle train_data, const char* parameters,
+                            BoosterHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)",
+                                 reinterpret_cast<PyObject*>(train_data),
+                                 parameters ? parameters : "");
+  PyObject* r = Call("booster_create", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+int LGBM_TrainBoosterCreateFromModelString(const char* model_str,
+                                           BoosterHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", model_str);
+  PyObject* r = Call("booster_create_from_model_string", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+int LGBM_TrainBoosterAddValidData(BoosterHandle handle, DatasetHandle valid,
+                                  const char* name) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OOs)", reinterpret_cast<PyObject*>(handle),
+                                 reinterpret_cast<PyObject*>(valid),
+                                 name ? name : "valid_0");
+  PyObject* r = Call("booster_add_valid", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_TrainBoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  Gil gil;
+  return GetInt("booster_update", reinterpret_cast<PyObject*>(handle),
+                is_finished);
+}
+
+int LGBM_TrainBoosterRollbackOneIter(BoosterHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle));
+  PyObject* r = Call("booster_rollback", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_TrainBoosterGetCurrentIteration(BoosterHandle handle, int* out) {
+  Gil gil;
+  return GetInt("booster_current_iteration",
+                reinterpret_cast<PyObject*>(handle), out);
+}
+
+int LGBM_TrainBoosterGetNumClasses(BoosterHandle handle, int* out) {
+  Gil gil;
+  return GetInt("booster_num_classes", reinterpret_cast<PyObject*>(handle),
+                out);
+}
+
+// caller owns nothing: the string lives until the next call on this thread
+int LGBM_TrainBoosterSaveModelToString(BoosterHandle handle,
+                                       int start_iteration, int num_iteration,
+                                       const char** out_str) {
+  Gil gil;
+  static thread_local std::string buf;
+  PyObject* args = Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                                 start_iteration, num_iteration);
+  PyObject* r = Call("booster_save_model_to_string", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  buf = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = buf.c_str();
+  return 0;
+}
+
+int LGBM_TrainBoosterSaveModel(BoosterHandle handle, int start_iteration,
+                               int num_iteration, const char* filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiis)", reinterpret_cast<PyObject*>(handle),
+                                 start_iteration, num_iteration, filename);
+  PyObject* r = Call("booster_save_model", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_TrainBoosterGetEval(BoosterHandle handle, const char** out_str) {
+  Gil gil;
+  static thread_local std::string buf;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle));
+  PyObject* r = Call("booster_get_eval", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  buf = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = buf.c_str();
+  return 0;
+}
+
+// predict_type: 0 normal, 1 raw, 2 leaf index, 3 contrib
+// (C_API_PREDICT_*, c_api.h:527-535)
+int LGBM_TrainBoosterPredictForMat(BoosterHandle handle, const double* data,
+                                   int nrow, int ncol, int predict_type,
+                                   int start_iteration, int num_iteration,
+                                   int64_t out_capacity, double* out_result,
+                                   int64_t* out_len) {
+  Gil gil;
+  PyObject* in_mv = View(data, static_cast<Py_ssize_t>(nrow) * ncol * 8);
+  PyObject* out_mv = View(out_result, out_capacity * 8, /*writable=*/true);
+  PyObject* args = Py_BuildValue("(OOiiiiiO)",
+                                 reinterpret_cast<PyObject*>(handle), in_mv,
+                                 nrow, ncol, predict_type, start_iteration,
+                                 num_iteration, out_mv);
+  Py_DECREF(in_mv);
+  Py_DECREF(out_mv);
+  PyObject* r = Call("booster_predict_mat", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_TrainBoosterFree(BoosterHandle handle) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,119 @@
+"""Multi-host training orchestration — the Dask-layer analog.
+
+The reference ships a process-orchestration layer
+(/root/reference/python-package/lightgbm/dask.py:393-810: allocate ports,
+build the ``machines`` parameter, run one trainer per worker wired through
+``LGBM_NetworkInit``; docs/Parallel-Learning-Guide.rst:45-140 for
+MPI/Kubeflow).  On TPU pods the runtime already provides process bring-up,
+so the analog collapses to: initialize ``jax.distributed`` (one process per
+host, auto-detected on TPU), build the global mesh, and run the SAME
+training call on every process with per-process data shards — SPMD instead
+of a task scheduler.
+
+Typical pod usage (same script on every host)::
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.parallel import launch
+
+    launch.init()                      # no-op off-pod / single process
+    shard = launch.row_shard(load_my_rows())   # this host's rows
+    mappers = launch.global_bin_mappers(shard.sample(200_000), config)
+    ds = lgb.Dataset(shard.x, label=shard.y, bin_mappers=mappers)
+    bst = lgb.train({"tree_learner": "data", ...}, ds)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional
+
+import numpy as np
+
+from ..config import Config
+
+
+class RowShard(NamedTuple):
+    """This process's row partition."""
+    x: np.ndarray
+    y: Optional[np.ndarray]
+    process_index: int
+    process_count: int
+
+    def sample(self, cnt: int, seed: int = 3) -> np.ndarray:
+        rng = np.random.RandomState(seed + self.process_index)
+        n = len(self.x)
+        if cnt >= n:
+            return self.x
+        return self.x[np.sort(rng.choice(n, size=cnt, replace=False))]
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         machines: Optional[str] = None,
+         local_listen_port: int = 12400) -> None:
+    """Bring up jax.distributed (LGBM_NetworkInit / dask._train machinery
+    analog).  ``machines`` accepts the reference's "ip1:port1,ip2:port2"
+    parameter format (config.h machines / dask.py:700) — the first entry
+    becomes the coordinator; rank is inferred by matching the local host.
+    On TPU pods, call with no arguments: everything is auto-detected."""
+    import jax
+
+    if jax.process_count() > 1 or getattr(init, "_done", False):
+        return
+    if machines:
+        entries = [m.strip() for m in machines.split(",") if m.strip()]
+        if coordinator_address is None:
+            coordinator_address = entries[0]
+        if num_processes is None:
+            num_processes = len(entries)
+        if process_id is None:
+            import socket
+            names = {socket.gethostname(), "127.0.0.1", "localhost"}
+            try:
+                names.add(socket.gethostbyname(socket.gethostname()))
+            except OSError:
+                pass
+            process_id = next(
+                (i for i, e in enumerate(entries)
+                 if e.rsplit(":", 1)[0] in names), None)
+            if process_id is None:
+                raise ValueError(
+                    f"local host not found in machines={machines!r}")
+    try:
+        if coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        else:
+            jax.distributed.initialize()
+        init._done = True
+    except (RuntimeError, ValueError):
+        # single-process / already-initialized runtimes: proceed solo, the
+        # same way the reference CLI falls back to serial when
+        # num_machines=1
+        init._done = True
+
+
+def row_shard(x: np.ndarray, y: Optional[np.ndarray] = None,
+              process_index: Optional[int] = None,
+              process_count: Optional[int] = None) -> RowShard:
+    """Deterministic contiguous row partition of a globally-loaded array
+    (the per-rank partitioning of dataset_loader.cpp:203-298).  When data
+    is already loaded per-host, wrap it in a RowShard directly."""
+    import jax
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    parts = np.array_split(np.arange(len(x)), pc)
+    idx = parts[pi]
+    return RowShard(x=x[idx], y=None if y is None else y[idx],
+                    process_index=pi, process_count=pc)
+
+
+def global_bin_mappers(local_sample: np.ndarray, config: Config,
+                       cat_idx: Optional[set] = None,
+                       allgather: Optional[Callable] = None) -> List:
+    """Globally-consistent bin mappers from per-host samples
+    (dist_data.distributed_bin_mappers; dataset_loader.cpp:1104-1186)."""
+    from .dist_data import distributed_bin_mappers
+    return distributed_bin_mappers(local_sample, config, cat_idx=cat_idx,
+                                   allgather=allgather)

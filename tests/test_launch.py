@@ -1,0 +1,74 @@
+"""Multi-host orchestration helpers (parallel/launch.py — the dask.py
+process-orchestration analog)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel import launch
+
+
+def test_row_shard_partition():
+    x = np.arange(100, dtype=np.float64).reshape(50, 2)
+    y = np.arange(50, dtype=np.float32)
+    shards = [launch.row_shard(x, y, process_index=i, process_count=4)
+              for i in range(4)]
+    assert sum(len(s.x) for s in shards) == 50
+    np.testing.assert_array_equal(np.vstack([s.x for s in shards]), x)
+    np.testing.assert_array_equal(np.concatenate([s.y for s in shards]), y)
+
+
+def test_machines_param_parsing(monkeypatch):
+    captured = {}
+
+    class FakeDist:
+        def initialize(self, **kw):
+            captured.update(kw)
+
+    import jax
+    monkeypatch.setattr(jax, "distributed", FakeDist())
+    monkeypatch.setattr(launch, "init", launch.init)  # reset memo
+    if hasattr(launch.init, "_done"):
+        del launch.init._done
+    launch.init(machines="127.0.0.1:12400,10.0.0.2:12400")
+    assert captured["coordinator_address"] == "127.0.0.1:12400"
+    assert captured["num_processes"] == 2
+    assert captured["process_id"] == 0
+    del launch.init._done
+
+
+def test_shard_sample_and_global_mappers():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4000, 6)
+    shards = [launch.row_shard(x, process_index=i, process_count=2)
+              for i in range(2)]
+    cfg = Config({"max_bin": 31})
+
+    import threading
+    mailbox = [None, None]
+    barrier = threading.Barrier(2)
+
+    def make_ag(rank):
+        def ag(payload):
+            mailbox[rank] = payload
+            barrier.wait(timeout=30)
+            out = list(mailbox)
+            barrier.wait(timeout=30)
+            return out
+        return ag
+
+    out = [None, None]
+
+    def run(rank):
+        from lightgbm_tpu.parallel.dist_data import distributed_bin_mappers
+        out[rank] = distributed_bin_mappers(
+            shards[rank].sample(1000), cfg, process_index=rank,
+            process_count=2, allgather=make_ag(rank))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(out[0]) == 6
+    for m0, m1 in zip(out[0], out[1]):
+        assert m0.num_bin == m1.num_bin

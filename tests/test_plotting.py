@@ -1,0 +1,75 @@
+"""Plotting module smoke tests (test_plotting.py analog, SURVEY.md §4)."""
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import plotting
+
+
+@pytest.fixture(scope="module")
+def model():
+    rs = np.random.RandomState(0)
+    x = rs.randn(800, 5)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    res = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "max_bin": 31,
+                     "verbosity": -1, "metric": "binary_logloss"},
+                    lgb.Dataset(x, label=y), num_boost_round=5,
+                    valid_sets=[lgb.Dataset(x, label=y, reference=None)],
+                    callbacks=[lgb.record_evaluation(res)])
+    return bst, res
+
+
+def test_plot_importance(model):
+    bst, _ = model
+    ax = plotting.plot_importance(bst)
+    assert ax is not None
+
+
+def test_plot_split_value_histogram(model):
+    bst, _ = model
+    feat = int(bst.trees[0].split_feature[0])
+    ax = plotting.plot_split_value_histogram(bst, feat)
+    assert ax is not None
+
+
+def test_plot_metric(model):
+    _, res = model
+    ax = plotting.plot_metric(res)
+    assert ax is not None
+
+
+def test_create_tree_digraph(model):
+    bst, _ = model
+    g = plotting.create_tree_digraph(bst, 0)
+    assert g  # dot source or graph object
+
+
+def test_plot_tree(model):
+    import shutil
+    if shutil.which("dot") is None:
+        pytest.skip("graphviz executable not installed")
+    bst, _ = model
+    ax = plotting.plot_tree(bst, tree_index=0)
+    assert ax is not None
+
+
+def test_custom_parser_registry(tmp_path):
+    """ParserFactory analog: user-registered format handlers."""
+    from lightgbm_tpu.data_io import load_text, register_parser
+    p = tmp_path / "data.weird"
+    p.write_text("1;1.0;2.0\n0;3.0;4.0\n")
+
+    def parse_weird(path, has_header, label_column):
+        rows = [ln.split(";") for ln in open(path) if ln.strip()]
+        arr = np.asarray(rows, np.float64)
+        return arr[:, 1:], arr[:, 0].astype(np.float32)
+
+    register_parser("weird", parse_weird)
+    x, y = load_text(str(p), fmt="weird")
+    assert x.shape == (2, 2)
+    np.testing.assert_array_equal(y, [1, 0])
