@@ -163,7 +163,9 @@ _PARAMS: Dict[str, tuple] = {
     "mesh_shape": (list, None, []),          # e.g. [8] or [4, 2]
     "mesh_axis_names": (list, None, []),     # e.g. ["data"] or ["data", "feature"]
     "hist_dtype": (str, "float32", []),      # histogram accumulation dtype
-    "tpu_learner": (str, "partitioned", []),  # partitioned | masked
+    # auto: partitioned on CPU, masked (one jitted program per tree) on
+    # accelerators where per-split host round-trips dominate
+    "tpu_learner": (str, "auto", []),  # auto | partitioned | masked
     "rows_per_block": (int, 0, []),          # 0 = auto-tune histogram row blocking
     "use_pallas": (bool, True, []),          # use Pallas kernels where available
     # ---- IO / task ----

@@ -88,12 +88,20 @@ def _is_scipy_sparse(data) -> bool:
 
 
 def _sample_rows(rng, n: int, cnt: int) -> np.ndarray:
-    """~cnt sorted unique row indices in O(cnt) memory (choice without
-    replacement would build an O(n) permutation — fatal for out-of-core n)."""
+    """cnt sorted unique row indices, unbiased, in O(cnt) memory (choice
+    without replacement builds an O(n) permutation — fatal for out-of-core
+    n when cnt << n)."""
     if cnt >= n:
         return np.arange(n, dtype=np.int64)
-    draw = rng.randint(0, n, size=int(cnt * 1.1) + 16).astype(np.int64)
-    return np.unique(draw)[:cnt]
+    if 2 * cnt >= n:  # dense sampling: O(n) = O(2 cnt), permutation is fine
+        return np.sort(rng.permutation(n)[:cnt]).astype(np.int64)
+    u = np.unique(rng.randint(0, n, size=int(cnt * 1.3) + 16).astype(np.int64))
+    while len(u) < cnt:  # collision top-up; cnt < n/2 so this converges fast
+        more = rng.randint(0, n, size=cnt).astype(np.int64)
+        u = np.unique(np.concatenate([u, more]))
+    if len(u) > cnt:  # drop uniformly, NOT from the tail (index bias)
+        u = np.sort(rng.choice(u, size=cnt, replace=False))
+    return u
 
 
 class Sequence:
@@ -203,6 +211,11 @@ class Dataset:
             # analog): bin column-at-a-time off the CSC layout — the only
             # dense product is the packed uint8 binned matrix.
             csc = self._raw_input.tocsc()
+            if not csc.has_sorted_indices:
+                # the sampled-column searchsorted path needs sorted
+                # per-column indices; copy so the caller's matrix is untouched
+                csc = csc.copy()
+                csc.sort_indices()
             names, pandas_cat = None, []
             self.num_data, self.num_total_features = csc.shape
 

@@ -113,6 +113,11 @@ class _HostSplit(NamedTuple):
 
 
 def _pull(res: SplitResult) -> _HostSplit:
+    """Convert a (device or already-fetched) SplitResult to host scalars.
+
+    Callers batching several results should jax.device_get the whole tuple
+    first — one transfer instead of ~10 blocking scalar reads per result,
+    which matters when the chip is behind a network tunnel."""
     return _HostSplit(
         gain=float(res.gain), feature=int(res.feature),
         threshold=int(res.threshold), default_left=bool(res.default_left),
@@ -218,9 +223,11 @@ class PartitionedGrower:
         hist0 = _hist_segment(order, binned, vals, jnp.int32(0), jnp.int32(n),
                               p=p_full, num_bins=self.BH,
                               block_rows=self.block_rows)
-        total0 = np.asarray(hist0[0].sum(axis=0))
-        root_out = float(leaf_output(jnp.float32(total0[0]),
-                                     jnp.float32(total0[1]), self.params))
+        total0_dev = hist0[0].sum(axis=0)
+        root_out_dev = leaf_output(total0_dev[0], total0_dev[1], self.params)
+        total0, root_out = jax.device_get((total0_dev, root_out_dev))
+        total0 = np.asarray(total0)
+        root_out = float(root_out)
         base_mask = np.asarray(feature_mask, bool)
         leaf_mask = {0: base_mask}
         inf = np.float32(np.finfo(np.float32).max)
@@ -368,7 +375,10 @@ class PartitionedGrower:
                 jnp.bool_(rec.is_cat), jnp.asarray(rec.bin_rank),
                 jnp.int32(begin), jnp.int32(cnt), p=p_seg)
             # actual moved-row count (with bagging, out-of-bag rows follow
-            # the split too, so segment size != in-bag left_sum count)
+            # the split too, so segment size != in-bag left_sum count).
+            # this is the split's one unavoidable host sync (the CUDA
+            # learner's D2H of the split description,
+            # cuda_single_gpu_tree_learner.cpp:118-228)
             cl = int(cl_dev)
             cr = cnt - cl
             begins[leaf], counts[leaf] = begin, cl
@@ -453,14 +463,17 @@ class PartitionedGrower:
             else:
                 leaf_lo[new], leaf_hi[new] = lo_p, hi_p
 
-            # new candidates for both children (async until pulled)
+            # new candidates for both children; dispatches are async, then
+            # ONE batched device_get for everything this split needs on host
             r_l = _find_leaf(hists[leaf], totals[leaf], parent_out[leaf], leaf)
             r_r = _find_leaf(hists[new], totals[new], parent_out[new], new)
-            cand[leaf] = _pull(r_l)
-            cand[new] = _pull(r_r)
-            for l in refresh:   # constraint drift -> re-search those leaves
-                cand[l] = _pull(_find_leaf(_get_hist(l), totals[l],
-                                           parent_out[l], l))
+            r_refresh = [_find_leaf(_get_hist(l), totals[l], parent_out[l], l)
+                         for l in refresh]
+            got = jax.device_get((r_l, r_r, r_refresh))
+            cand[leaf] = _pull(got[0])
+            cand[new] = _pull(got[1])
+            for l, r in zip(refresh, got[2]):
+                cand[l] = _pull(r)
             num_leaves = new + 1
             order_box[0] = order
 

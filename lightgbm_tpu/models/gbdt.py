@@ -76,11 +76,24 @@ class GBDTModel:
         if self.num_features == 0:
             raise ValueError("Dataset has no usable (non-trivial) features")
 
+        # learner selection (the device_type axis, tree_learner.cpp:16-64):
+        # - partitioned: host-orchestrated, histogram work ∝ smaller child —
+        #   wins when dispatch is cheap (CPU) or trees are huge
+        # - masked: ONE jitted program per tree (the cuda_exp stance,
+        #   cuda_single_gpu_tree_learner.cpp) — wins on accelerators where
+        #   per-split host round-trips dominate (esp. remote/tunneled chips)
+        learner = config.tpu_learner
+        if learner == "auto":
+            import jax
+            learner = "partitioned" if jax.default_backend() == "cpu" \
+                else "masked"
+        self._learner_kind = learner
+
         # device-resident binned matrix + per-feature bin metadata.
         # EFB (efb.py): the grouped layout is kept for the partitioned
         # learner; other learners take the flat per-feature layout.
         self._use_efb = (ds.efb is not None and hist_reduce is None
-                         and config.tpu_learner == "partitioned")
+                         and learner == "partitioned")
         self.binned_dev = jnp.asarray(ds.binned if self._use_efb
                                       else ds.feature_binned())
         num_bin = np.asarray([ds.bin_mappers[f].num_bin for f in ds.used_features],
@@ -131,7 +144,13 @@ class GBDTModel:
             or inter is not None or config.feature_fraction_bynode < 1.0 \
             or self._cegb_state is not None or self._forced_spec is not None
 
-        if hist_reduce is None and config.tpu_learner == "partitioned":
+        if has_node_controls and learner != "partitioned" \
+                and config.tpu_learner == "auto":
+            # node-level controls are host bookkeeping -> partitioned only
+            # (auto falls back silently; explicit masked still errors below)
+            learner = "partitioned"
+            self._learner_kind = learner
+        if hist_reduce is None and learner == "partitioned":
             # single-chip performance learner (grower_partitioned.py):
             # histogram work ∝ smaller child, like the reference
             from ..grower_partitioned import PartitionedGrower
@@ -499,8 +518,13 @@ class GBDTModel:
                     gkw["cegb_state"] = self._cegb_state
             arrays = self.grower(self.binned_dev, vals, fmask,
                                  self.num_bin_dev, self.na_bin_dev, **gkw)
-            nl = int(arrays.num_leaves)
-            leaf_values = np.asarray(arrays.leaf_value, np.float64).copy()
+            # ONE batched host transfer of the tree-sized fields; the [N]
+            # leaf_of_row stays on device (only pulled when renew/linear
+            # paths need it) — matters when the chip is behind a tunnel
+            small = arrays._replace(leaf_of_row=arrays.num_leaves)
+            host = jax.device_get(small)._replace(leaf_of_row=arrays.leaf_of_row)
+            nl = int(host.num_leaves)
+            leaf_values = np.asarray(host.leaf_value, np.float64).copy()
             if nl <= 1:
                 leaf_values[:] = 0.0  # stump contributes nothing (gbdt.cpp warn)
             else:
@@ -522,8 +546,9 @@ class GBDTModel:
             dev_values = leaf_values + bias
             host_values = leaf_values + init_scores[k]  # Tree::AddBias
 
-            # host tree
-            ht = Tree.from_arrays(arrays, self.train_set.used_features,
+            # host tree (from the already-fetched host copy — from_arrays
+            # never reads leaf_of_row)
+            ht = Tree.from_arrays(host, self.train_set.used_features,
                                   self.train_set.bin_mappers)
             ht.internal_value = ht.internal_value * shrinkage
             ht.shrinkage = shrinkage

@@ -39,11 +39,12 @@ class RowShard(NamedTuple):
     process_count: int
 
     def sample(self, cnt: int, seed: int = 3) -> np.ndarray:
+        from ..dataset import _sample_rows
         rng = np.random.RandomState(seed + self.process_index)
         n = len(self.x)
         if cnt >= n:
             return self.x
-        return self.x[np.sort(rng.choice(n, size=cnt, replace=False))]
+        return self.x[_sample_rows(rng, n, cnt)]
 
 
 def init(coordinator_address: Optional[str] = None,
@@ -55,10 +56,14 @@ def init(coordinator_address: Optional[str] = None,
     analog).  ``machines`` accepts the reference's "ip1:port1,ip2:port2"
     parameter format (config.h machines / dask.py:700) — the first entry
     becomes the coordinator; rank is inferred by matching the local host.
-    On TPU pods, call with no arguments: everything is auto-detected."""
+    On TPU pods, call with no arguments: everything is auto-detected.
+
+    MUST run before any other JAX call (jax.distributed.initialize refuses
+    to run once XLA backends exist) — so no jax.* probing happens here
+    before the initialize attempt."""
     import jax
 
-    if jax.process_count() > 1 or getattr(init, "_done", False):
+    if getattr(init, "_done", False):
         return
     if machines:
         entries = [m.strip() for m in machines.split(",") if m.strip()]
