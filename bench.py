@@ -3,18 +3,37 @@
 Mirrors the reference's headline experiment (docs/Experiments.rst: HIGGS,
 500 iterations, num_leaves=255 -> 130.094 s on 2x E5-2690v4, i.e. 3.843
 iters/s; GPU docs recommend 63 bins for accelerator runs,
-docs/GPU-Performance.rst:108-124).  This round benches a 1M-row slice of
-that shape at num_leaves=31, max_bin=63; ``vs_baseline`` is our steady-state
-iters/s over the reference's full-size 3.843 iters/s.
+docs/GPU-Performance.rst:108-124).  This benches a 1M-row slice of that
+shape; ``vs_baseline`` is our steady-state iters/s over the reference's
+full-size 3.843 iters/s.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness (round-1 postmortem: one TPU-claim hiccup lost the round's perf
+signal): the measurement runs in a CHILD process; the parent retries with
+backoff on failure, falls back to a reduced CPU run as a last resort, and
+ALWAYS prints exactly one JSON line
+{"metric", "value", "unit", "vs_baseline"[, "error"]}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+BASELINE_IPS = 500.0 / 130.094  # reference HIGGS CPU (Experiments.rst:113)
+METRIC = "higgs1m_binary_train_iters_per_sec"
+N_ROWS, N_FEAT = 1_000_000, 28
+ITERS = 100
+
+# bf16/f32 MXU peak per chip for MFU estimate (How-to-Scale-Your-Model
+# hardware tables); unknown kinds report FLOP/s only.
+PEAK_FLOPS = {
+    # device_kind strings normalize like "tpuv5lite" / "tpuv4" etc.
+    "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v4": 275e12, "v6e": 918e12, "v6lite": 918e12,
+}
 
 
 def make_higgs_like(n: int, f: int, seed: int = 0):
@@ -26,25 +45,26 @@ def make_higgs_like(n: int, f: int, seed: int = 0):
     return x, y
 
 
-def main():
-    n, f = 1_000_000, 28
-    iters = 100
-    x, y = make_higgs_like(n, f)
+def child(iters: int) -> None:
+    """The actual measurement; prints the JSON line on success."""
+    x, y = make_higgs_like(N_ROWS, N_FEAT)
 
     print("[bench] data ready; importing jax / claiming device...",
           file=sys.stderr, flush=True)
     t_dev = time.time()
     import jax
-    print(f"[bench] devices={jax.devices()} ({time.time() - t_dev:.1f}s)",
+    devs = jax.devices()
+    print(f"[bench] devices={devs} ({time.time() - t_dev:.1f}s)",
           file=sys.stderr, flush=True)
     import lightgbm_tpu as lgb
     from lightgbm_tpu.metrics import _auc
 
+    num_leaves, max_bin = 31, 63
     params = {
         "objective": "binary",
-        "num_leaves": 31,
+        "num_leaves": num_leaves,
         "learning_rate": 0.1,
-        "max_bin": 63,
+        "max_bin": max_bin,
         "min_data_in_leaf": 20,
         "verbosity": 0,
     }
@@ -60,25 +80,95 @@ def main():
     t_compile = time.time() - t0
 
     t1 = time.time()
-    for _ in range(iters - 1):
+    for i in range(iters - 1):
         bst.update()
+        if (i + 1) % 20 == 0:
+            print(f"[bench] iter {i + 1}/{iters - 1} "
+                  f"({(i + 1) / (time.time() - t1):.2f} iters/s)",
+                  file=sys.stderr, flush=True)
     # force device sync
     np.asarray(bst._model.score)
     dt = time.time() - t1
     ips = (iters - 1) / dt
 
+    # observability: achieved histogram FLOP/s + MFU estimate.  Dominant
+    # work per iteration is the one-hot-matmul histogram pass per split:
+    # [3, N] @ [N, F*B] = 2*3*N*F*B FLOPs, (num_leaves-1) splits/tree
+    # (subtraction trick already halves what a naive build would do).
+    hist_flops_per_iter = 2.0 * 3 * N_ROWS * N_FEAT * max_bin * (num_leaves - 1)
+    achieved = hist_flops_per_iter * ips
+    kind = devs[0].device_kind.lower().replace(" ", "")
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)
+    mfu = f"{achieved / peak:.1%}" if peak else "n/a"
     auc = _auc(y, np.asarray(bst._model.train_score())[:, 0], None)
     print(f"[bench] bin={t_bin:.1f}s compile+iter1={t_compile:.1f}s "
-          f"steady={dt:.1f}s for {iters-1} iters -> {ips:.2f} iters/s "
-          f"train-AUC={auc:.4f}", file=sys.stderr)
+          f"steady={dt:.1f}s for {iters - 1} iters -> {ips:.2f} iters/s "
+          f"train-AUC={auc:.4f} hist~{achieved / 1e12:.2f} TFLOP/s "
+          f"(MFU~{mfu} of {devs[0].device_kind})", file=sys.stderr)
 
-    baseline_ips = 500.0 / 130.094  # reference HIGGS CPU (Experiments.rst:113)
     print(json.dumps({
-        "metric": "higgs1m_binary_train_iters_per_sec",
+        "metric": METRIC,
         "value": round(ips, 3),
         "unit": "iters/s (1M rows x 28 feat, 31 leaves, 63 bins)",
-        "vs_baseline": round(ips / baseline_ips, 3),
-    }))
+        "vs_baseline": round(ips / BASELINE_IPS, 3),
+    }), flush=True)
+
+
+def run_child(extra_env, iters: int, timeout: int):
+    env = dict(os.environ, _BENCH_CHILD="1", _BENCH_ITERS=str(iters))
+    env.update(extra_env)
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired.stderr is bytes even under text=True
+        err_txt = (e.stderr.decode(errors="replace")
+                   if isinstance(e.stderr, bytes) else (e.stderr or ""))
+        sys.stderr.write(err_txt[-2000:])
+        return None, f"timeout after {timeout}s"
+    sys.stderr.write(r.stderr[-4000:] if r.stderr else "")
+    for line in (r.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and METRIC in line:
+            return line, None
+    return None, f"rc={r.returncode}, no JSON line"
+
+
+def main():
+    if os.environ.get("_BENCH_CHILD"):
+        child(int(os.environ.get("_BENCH_ITERS", ITERS)))
+        return
+
+    errors = []
+    # attempt 1-3: the default backend (TPU when available), with backoff —
+    # transient tunnel/claim failures were the round-1 failure mode
+    for attempt, backoff in enumerate((0, 20, 60)):
+        if backoff:
+            print(f"[bench] retrying in {backoff}s...", file=sys.stderr,
+                  flush=True)
+            time.sleep(backoff)
+        line, err = run_child({}, ITERS, timeout=2400)
+        if line:
+            print(line, flush=True)
+            return
+        errors.append(f"attempt{attempt + 1}: {err}")
+        print(f"[bench] attempt {attempt + 1} failed: {err}", file=sys.stderr,
+              flush=True)
+
+    # last resort: reduced-iteration CPU run — an honest degraded number
+    # beats no number
+    line, err = run_child({"JAX_PLATFORMS": "cpu"}, 12, timeout=2400)
+    if line:
+        rec = json.loads(line)
+        rec["error"] = ("degraded: accelerator unavailable, CPU fallback; "
+                        + "; ".join(errors))
+        print(json.dumps(rec), flush=True)
+        return
+    errors.append(f"cpu-fallback: {err}")
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "iters/s",
+        "vs_baseline": 0.0, "error": "; ".join(errors)}), flush=True)
 
 
 if __name__ == "__main__":

@@ -99,6 +99,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 hist_view: Optional[Callable] = None,
                 select_best: Optional[Callable] = None,
                 subtract: bool = True,
+                gather: bool = True, min_gather_rows: int = 4096,
+                count_reduce: Optional[Callable] = None,
+                sum_reduce: Optional[Callable] = None,
+                efb=None,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
@@ -114,6 +118,24 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       while ``na_bin_part`` carries the global array for row partitioning.
     - select_best: cross-shard reduction of a SplitResult (feature-parallel
       argmax + feature-index globalization; identity for serial).
+    - gather/min_gather_rows: child histograms are built from a COMPACTED
+      row gather into the smallest power-of-2 capacity tier that fits the
+      child (``lax.switch`` over tiers), so per-split matmul work is
+      ∝ rows-in-smaller-child like the reference
+      (serial_tree_learner.cpp:283-323 smaller-leaf discipline;
+      cuda_histogram_constructor's leaf-indexed construction) instead of a
+      full-N masked pass.  Below ``min_gather_rows`` tiers stop (compile
+      cost isn't worth it).
+    - count_reduce: makes the tier choice uniform across shards (pmax over
+      the mesh axis) so collectives inside the switch stay congruent; must
+      be set whenever hist_reduce crosses shards.
+    - efb: an ``EFBDevice`` — ``binned`` is then the BUNDLED group matrix
+      [N, G] (dataset.cpp:239 FastFeatureBundling); histograms are built
+      and subtracted in the narrow group space (the HBM-bandwidth win) and
+      expanded to feature space only for split search, with the leaf's
+      totals reconstructing the shared default bin (FixHistogram,
+      dataset.cpp:1292).  Row partitioning decodes the winning feature's
+      bins from its group column.
     """
     L = int(num_leaves)
     B = int(num_bins)
@@ -121,11 +143,62 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     view_fn = hist_view or (lambda b: b)
     select_fn = select_best or (lambda r: r)
     use_subtraction = subtract
+    Bh = int(efb.group_bins) if efb is not None else B   # histogram bin axis
+    if efb is not None:
+        from .efb import expand_group_hist
+        efb_off_dev = jnp.asarray(efb.off_host)
+
+        def _expand(gh, total):
+            return expand_group_hist(gh, total, efb.group_of_feat,
+                                     efb.col_idx, efb.fix0)
+    else:
+        def _expand(gh, total):
+            return gh
 
     def _hist(binned_view, vals):
-        h = compute_histogram(binned_view, vals, num_bins=B,
+        h = compute_histogram(binned_view, vals, num_bins=Bh,
                               block_rows=block_rows)
         return reduce_fn(h)
+
+    def _make_child_hist(n: int):
+        """Child-histogram builder: tiered gather (see ``gather`` above)
+        with a masked full-N pass as the top tier / fallback."""
+        caps = []
+        if gather:
+            c = int(min_gather_rows)
+            while c < n:
+                caps.append(c)
+                c *= 2
+
+        def child_hist(binned_view, vals, leaf_of_row, child_id):
+            in_child = leaf_of_row == child_id
+
+            def full_pass(_):
+                mask = in_child.astype(vals.dtype)[:, None]
+                return _hist(binned_view, vals * mask)
+
+            if not caps:
+                return full_pass(None)
+            count = jnp.sum(in_child.astype(jnp.int32))
+            if count_reduce is not None:
+                count = count_reduce(count)
+            tier = jnp.searchsorted(jnp.asarray(caps, jnp.int32), count,
+                                    side="left")
+
+            def gather_tier(cap):
+                def f(_):
+                    idx = jnp.nonzero(in_child, size=cap, fill_value=n)[0]
+                    safe = jnp.minimum(idx, n - 1)
+                    b_g = jnp.take(binned_view, safe, axis=0)
+                    v_g = jnp.take(vals, safe, axis=0) \
+                        * (idx < n)[:, None].astype(vals.dtype)
+                    return _hist(b_g, v_g)
+                return f
+
+            return lax.switch(tier, [gather_tier(c) for c in caps]
+                              + [full_pass], None)
+
+        return child_hist
 
     def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2, is_cat):
         return jax.vmap(
@@ -139,20 +212,33 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
         f = binned_view.shape[1]
+        child_hist = _make_child_hist(n)
         if na_bin_part is None:
             na_bin_part = na_bin
 
-        hist0 = _hist(binned_view, vals)                  # [F, B, 3]
-        total0 = hist0[0].sum(axis=0)                     # [3] root aggregates
+        hist0 = _hist(binned_view, vals)            # [F|G, B|Bg, 3]
+        # root aggregates from vals directly, NOT from hist0[0]: a filtering
+        # hist_reduce (voting's top-k zeroing) may have dropped feature 0's
+        # histogram, and this is also one less reduction of a big tensor
+        if sum_reduce is not None:
+            total0 = sum_reduce(vals.sum(axis=0))
+        elif hist_reduce is not None:
+            # caller-supplied reduce hook without a sum_reduce: derive the
+            # totals from the reduced histogram so cross-shard hooks keep
+            # seeing globally-reduced root aggregates
+            total0 = hist0[0].sum(axis=0)
+        else:
+            total0 = vals.sum(axis=0)
         root_out = leaf_output(total0[0], total0[1], params)
-        res0 = select_fn(find_best_split(hist0, total0, num_bin, na_bin,
-                                         feature_mask, params, root_out,
-                                         is_cat))
+        res0 = select_fn(find_best_split(_expand(hist0, total0), total0,
+                                         num_bin, na_bin, feature_mask,
+                                         params, root_out, is_cat))
 
         neg_inf = jnp.float32(-jnp.inf)
         st = _GrowState(
             leaf_of_row=jnp.zeros(n, jnp.int32),
-            hist=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(hist0),
+            hist=jnp.zeros((L, binned_view.shape[1], Bh, 3),
+                           jnp.float32).at[0].set(hist0),
             bg=jnp.full(L, neg_inf).at[0].set(res0.gain),
             bf=jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
             bt=jnp.zeros(L, jnp.int32).at[0].set(res0.threshold),
@@ -206,7 +292,17 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 # --- partition rows (CUDADataPartition::Split analog) -----
                 # decision rank unifies numerical (iota rank) and
                 # categorical (ratio-order rank) predicates
-                fcol = jnp.take(binned, feat, axis=1).astype(jnp.int32)
+                if efb is None:
+                    fcol = jnp.take(binned, feat, axis=1).astype(jnp.int32)
+                else:
+                    # decode the feature's bins from its bundle column
+                    # (SubFeatureIterator analog, feature_group.h)
+                    gcol = jnp.take(binned, efb.group_of_feat[feat],
+                                    axis=1).astype(jnp.int32)
+                    off = efb_off_dev[feat]
+                    in_range = (gcol >= off) & (gcol < off + num_bin[feat] - 1)
+                    fcol = jnp.where(off < 0, gcol,
+                                     jnp.where(in_range, gcol - off + 1, 0))
                 nb = na_bin_part[feat]
                 is_na = (nb >= 0) & (fcol == nb) & (~icat)
                 go_left = jnp.where(is_na, dleft, rank_vec[fcol] <= thr)
@@ -217,18 +313,17 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 # --- histograms: smaller child + subtraction --------------
                 smaller_left = lsum[2] <= rsum[2]
                 smaller_id = jnp.where(smaller_left, leaf, new_leaf)
-                mask = (leaf_of_row == smaller_id).astype(vals.dtype)[:, None]
-                hist_small = _hist(binned_view, vals * mask)
+                hist_small = child_hist(binned_view, vals, leaf_of_row,
+                                        smaller_id)
                 if use_subtraction:
                     hist_large = st.hist[leaf] - hist_small
                 else:
                     # voting-parallel: per-split feature votes make the
                     # reduced hist feature sets differ between parent and
                     # children, so the larger child is constructed too
-                    lmask = (leaf_of_row == jnp.where(smaller_left, new_leaf,
-                                                      leaf)) \
-                        .astype(vals.dtype)[:, None]
-                    hist_large = _hist(binned_view, vals * lmask)
+                    larger_id = jnp.where(smaller_left, new_leaf, leaf)
+                    hist_large = child_hist(binned_view, vals, leaf_of_row,
+                                            larger_id)
                 hl_leaf = jnp.where(smaller_left, hist_small, hist_large)
                 hl_new = jnp.where(smaller_left, hist_large, hist_small)
                 hist = st.hist.at[leaf].set(hl_leaf).at[new_leaf].set(hl_new)
@@ -245,8 +340,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 hist2 = jnp.stack([hl_leaf, hl_new])
                 tot2 = jnp.stack([lsum, rsum])
                 po2 = jnp.stack([st.blo[leaf], st.bro[leaf]])
-                r2 = _best2(hist2, tot2, num_bin, na_bin, feature_mask, po2,
-                            is_cat)
+                r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
+                            na_bin, feature_mask, po2, is_cat)
                 depth_ok = (max_depth <= 0) | (d < max_depth)
                 g2 = jnp.where(depth_ok, r2.gain, -jnp.inf)
 

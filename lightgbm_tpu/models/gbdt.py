@@ -87,35 +87,6 @@ class GBDTModel:
             import jax
             learner = "partitioned" if jax.default_backend() == "cpu" \
                 else "masked"
-        self._learner_kind = learner
-
-        # device-resident binned matrix + per-feature bin metadata.
-        # EFB (efb.py): the grouped layout is kept for the partitioned
-        # learner; other learners take the flat per-feature layout.
-        self._use_efb = (ds.efb is not None and hist_reduce is None
-                         and learner == "partitioned")
-        self.binned_dev = jnp.asarray(ds.binned if self._use_efb
-                                      else ds.feature_binned())
-        num_bin = np.asarray([ds.bin_mappers[f].num_bin for f in ds.used_features],
-                             np.int32)
-        na_bin = np.asarray([ds.bin_mappers[f].na_bin for f in ds.used_features],
-                            np.int32)
-        self.num_bin_dev = jnp.asarray(num_bin)
-        self.na_bin_dev = jnp.asarray(na_bin)
-        from ..binning import BinType
-        is_cat = np.asarray([ds.bin_mappers[f].bin_type == BinType.CATEGORICAL
-                             for f in ds.used_features], bool)
-        self.is_cat_dev = jnp.asarray(is_cat) if is_cat.any() else None
-        self.max_bin = int(num_bin.max())
-        if self._use_efb:
-            from ..efb import make_device_efb
-            self.efb_dev = make_device_efb(ds.efb, num_bin, self.max_bin)
-            self.efb_maps = (self.efb_dev.group_of_feat,
-                             jnp.asarray(ds.efb.off_of_feat),
-                             jnp.asarray(num_bin - 1))
-        else:
-            self.efb_dev = None
-            self.efb_maps = None
 
         self.split_params = SplitParams(
             lambda_l1=config.lambda_l1,
@@ -149,8 +120,119 @@ class GBDTModel:
             # node-level controls are host bookkeeping -> partitioned only
             # (auto falls back silently; explicit masked still errors below)
             learner = "partitioned"
-            self._learner_kind = learner
-        if hist_reduce is None and learner == "partitioned":
+
+        # distributed learner selection (tree_learner.cpp:16-64 factory;
+        # config auto-promotes serial->data when num_machines>1).  The
+        # distributed growers are shard_map wrappers around the masked
+        # one-program grower (parallel/{data,feature,voting}_parallel.py).
+        dist = config.tree_learner \
+            if config.tree_learner in ("data", "feature", "voting") else None
+        self._mesh = None
+        self._row_pad = 0
+        self._feat_pad = 0
+        self._dist_axis = "feature" if dist == "feature" else "data"
+        if dist is not None and hist_reduce is None:
+            self._mesh = self._resolve_mesh(config, self._dist_axis)
+            if self._mesh is None:
+                dist = None             # single device -> serial (warned)
+            elif has_node_controls:
+                raise ValueError(
+                    "monotone/interaction constraints, CEGB, forced splits "
+                    "and feature_fraction_bynode are not supported with "
+                    f"tree_learner={dist} (they require the single-chip "
+                    "partitioned learner)")
+            else:
+                learner = "masked"
+        else:
+            dist = None
+        self._dist = dist
+        self._learner_kind = learner
+
+        # device-resident binned matrix + per-feature bin metadata.
+        # EFB (efb.py): the grouped layout is used by BOTH single-chip
+        # learners (dataset.cpp:239 always-on stance); the distributed
+        # shard_map paths take the flat per-feature layout.
+        self._use_efb = (ds.efb is not None and hist_reduce is None
+                         and learner in ("partitioned", "masked")
+                         and dist is None)
+        feat_binned = ds.binned if self._use_efb else ds.feature_binned()
+        num_bin = np.asarray([ds.bin_mappers[f].num_bin for f in ds.used_features],
+                             np.int32)
+        na_bin = np.asarray([ds.bin_mappers[f].na_bin for f in ds.used_features],
+                            np.int32)
+        self.num_bin_dev = jnp.asarray(num_bin)
+        self.na_bin_dev = jnp.asarray(na_bin)
+        from ..binning import BinType
+        is_cat = np.asarray([ds.bin_mappers[f].bin_type == BinType.CATEGORICAL
+                             for f in ds.used_features], bool)
+        self.is_cat_dev = jnp.asarray(is_cat) if is_cat.any() else None
+        self.max_bin = int(num_bin.max())
+        if self._use_efb:
+            from ..efb import make_device_efb
+            self.efb_dev = make_device_efb(ds.efb, num_bin, self.max_bin)
+            self.efb_maps = (self.efb_dev.group_of_feat,
+                             jnp.asarray(ds.efb.off_of_feat),
+                             jnp.asarray(num_bin - 1))
+        else:
+            self.efb_dev = None
+            self.efb_maps = None
+
+        # grower-facing bin metadata (== the user-facing arrays unless the
+        # feature axis is padded for feature-parallel sharding)
+        self._nb_grow = self.num_bin_dev
+        self._na_grow = self.na_bin_dev
+        self._ic_grow = self.is_cat_dev
+        if dist in ("data", "voting"):
+            from ..parallel.data_parallel import shard_rows
+            n_sh = self._mesh.shape[self._dist_axis]
+            self._row_pad = (-self.num_data) % n_sh
+            if self._row_pad:
+                feat_binned = np.concatenate(
+                    [feat_binned, np.zeros((self._row_pad,
+                                            feat_binned.shape[1]),
+                                           feat_binned.dtype)], axis=0)
+            self.binned_dev = shard_rows(self._mesh, feat_binned,
+                                         self._dist_axis)
+        elif dist == "feature":
+            n_sh = self._mesh.shape[self._dist_axis]
+            self._feat_pad = (-self.num_features) % n_sh
+            if self._feat_pad:
+                feat_binned = np.concatenate(
+                    [feat_binned, np.zeros((feat_binned.shape[0],
+                                            self._feat_pad),
+                                           feat_binned.dtype)], axis=1)
+                pad_i = np.full(self._feat_pad, 2, np.int32)
+                self._nb_grow = jnp.asarray(np.concatenate([num_bin, pad_i]))
+                self._na_grow = jnp.asarray(np.concatenate(
+                    [na_bin, np.full(self._feat_pad, -1, np.int32)]))
+                if self.is_cat_dev is not None:
+                    self._ic_grow = jnp.asarray(np.concatenate(
+                        [is_cat, np.zeros(self._feat_pad, bool)]))
+            self.binned_dev = jnp.asarray(feat_binned)
+        else:
+            self.binned_dev = jnp.asarray(feat_binned)
+
+        if dist == "data":
+            from ..parallel.data_parallel import make_dp_grower
+            self.grower = make_dp_grower(
+                self._mesh, num_leaves=config.num_leaves,
+                num_bins=self.max_bin, params=self.split_params,
+                max_depth=config.max_depth, block_rows=config.rows_per_block)
+        elif dist == "voting":
+            from ..parallel.voting_parallel import make_voting_grower
+            self.grower = make_voting_grower(
+                self._mesh, num_leaves=config.num_leaves,
+                num_bins=self.max_bin, params=self.split_params,
+                top_k=config.top_k, max_depth=config.max_depth,
+                block_rows=config.rows_per_block)
+        elif dist == "feature":
+            from ..parallel.feature_parallel import make_fp_grower
+            self.grower = make_fp_grower(
+                self._mesh, num_features=self.num_features + self._feat_pad,
+                num_leaves=config.num_leaves, num_bins=self.max_bin,
+                params=self.split_params, max_depth=config.max_depth,
+                block_rows=config.rows_per_block)
+        elif hist_reduce is None and learner == "partitioned":
             # single-chip performance learner (grower_partitioned.py):
             # histogram work ∝ smaller child, like the reference
             from ..grower_partitioned import PartitionedGrower
@@ -175,7 +257,12 @@ class GBDTModel:
             self.grower = make_grower(
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
-                block_rows=config.rows_per_block, hist_reduce=hist_reduce)
+                block_rows=config.rows_per_block, hist_reduce=hist_reduce,
+                # a caller-supplied cross-shard hook comes without a
+                # count_reduce, so gather tiers could pick divergent
+                # switch branches per shard -> keep the full-pass path
+                gather=hist_reduce is None,
+                efb=self.efb_dev if self._use_efb else None)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
             raise ValueError("linear_tree requires boosting=gbdt")
@@ -343,6 +430,53 @@ class GBDTModel:
                           / bytes_per_leaf))
 
     @staticmethod
+    def _resolve_mesh(config: Config, axis: str):
+        """Device mesh for tree_learner=data|feature|voting
+        (tree_learner.cpp:16-64 factory dispatch; the mesh replaces the
+        reference's machine list, SURVEY.md §2.5).  Size precedence:
+        ``mesh_shape`` > ``num_machines`` > all visible devices.  Returns
+        None (serial fallback, with a warning) on a single device —
+        the reference's num_machines=1 degenerate case."""
+        import jax
+        from ..parallel import make_mesh
+        from ..utils.log import Log
+        devs = jax.devices()
+        if config.mesh_shape:
+            n = int(np.prod(config.mesh_shape))
+        elif config.num_machines > 1:
+            n = config.num_machines
+        else:
+            n = len(devs)
+        if n > len(devs):
+            raise ValueError(
+                f"tree_learner={config.tree_learner} needs {n} devices "
+                f"(mesh_shape/num_machines), only {len(devs)} visible")
+        if n <= 1:
+            Log.warning(
+                f"tree_learner={config.tree_learner} requested but only one "
+                "device is visible; training serially")
+            return None
+        return make_mesh((n,), (axis,), devs)
+
+    def _prep_vals(self, vals: jax.Array) -> jax.Array:
+        """Pad + row-shard the per-row (grad, hess, weight) stack for the
+        row-sharded learners; identity otherwise.  Padded rows carry zero
+        weight so they never contribute to histograms."""
+        if self._dist not in ("data", "voting"):
+            return vals
+        if self._row_pad:
+            vals = jnp.concatenate(
+                [vals, jnp.zeros((self._row_pad, vals.shape[1]), vals.dtype)])
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(
+            vals, NamedSharding(self._mesh, P(self._dist_axis, None)))
+
+    def _prep_fmask(self, fmask: jax.Array) -> jax.Array:
+        if self._feat_pad:
+            return jnp.concatenate([fmask, jnp.zeros(self._feat_pad, bool)])
+        return fmask
+
+    @staticmethod
     def _interaction_allow(config: Config, ds: Dataset):
         """Parse interaction_constraints ("[0,1],[2,3]" over original feature
         indices) into an allowed-interaction matrix over used-feature slots
@@ -508,16 +642,28 @@ class GBDTModel:
                 w = jnp.ones(self.num_data, jnp.float32)
             vals = jnp.stack([g * w, h * w, w], axis=1)
             gkw = {}
-            if self.is_cat_dev is not None:
-                gkw["is_cat"] = self.is_cat_dev
+            if self._ic_grow is not None:
+                gkw["is_cat"] = self._ic_grow
             from ..grower_partitioned import PartitionedGrower
             if isinstance(self.grower, PartitionedGrower):
                 if self._forced_spec is not None:
                     gkw["forced"] = self._forced_spec
                 if self._cegb_state is not None:
                     gkw["cegb_state"] = self._cegb_state
-            arrays = self.grower(self.binned_dev, vals, fmask,
-                                 self.num_bin_dev, self.na_bin_dev, **gkw)
+            vals_g = self._prep_vals(vals)
+            fmask_g = self._prep_fmask(fmask)
+            if self._dist == "feature":
+                arrays = self.grower(self.binned_dev, vals_g, fmask_g,
+                                     self._nb_grow, self._na_grow,
+                                     self._na_grow, **gkw)
+            else:
+                arrays = self.grower(self.binned_dev, vals_g, fmask_g,
+                                     self._nb_grow, self._na_grow, **gkw)
+            if self._row_pad:
+                # drop padded rows before any host/score use of the
+                # row->leaf vector
+                arrays = arrays._replace(
+                    leaf_of_row=arrays.leaf_of_row[:self.num_data])
             # ONE batched host transfer of the tree-sized fields; the [N]
             # leaf_of_row stays on device (only pulled when renew/linear
             # paths need it) — matters when the chip is behind a tunnel

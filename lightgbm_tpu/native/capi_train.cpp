@@ -49,6 +49,17 @@ class Gil {
   PyGILState_STATE state_;
 };
 
+// PyUnicode_AsUTF8 may return nullptr on conversion failure; constructing
+// std::string from nullptr is UB, so always funnel through this.
+const char* SafeUTF8(PyObject* s, const char* fallback) {
+  const char* p = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (!p) {
+    PyErr_Clear();
+    return fallback;
+  }
+  return p;
+}
+
 int PyError() {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
   PyErr_Fetch(&type, &value, &tb);
@@ -57,7 +68,7 @@ int PyError() {
   if (value) {
     PyObject* s = PyObject_Str(value);
     if (s) {
-      msg = PyUnicode_AsUTF8(s);
+      msg = SafeUTF8(s, "python error (unprintable)");
       Py_DECREF(s);
     }
   }
@@ -156,8 +167,10 @@ static int GetInt(const char* fn, PyObject* obj, int* out) {
   PyObject* r = Call(fn, args);
   Py_DECREF(args);
   if (!r) return PyError();
-  *out = static_cast<int>(PyLong_AsLong(r));
+  long v = PyLong_AsLong(r);
   Py_DECREF(r);
+  if (v == -1 && PyErr_Occurred()) return PyError();
+  *out = static_cast<int>(v);
   return 0;
 }
 
@@ -254,7 +267,12 @@ int LGBM_TrainBoosterSaveModelToString(BoosterHandle handle,
   PyObject* r = Call("booster_save_model_to_string", args);
   Py_DECREF(args);
   if (!r) return PyError();
-  buf = PyUnicode_AsUTF8(r);
+  const char* p = PyUnicode_AsUTF8(r);
+  if (!p) {  // conversion failure must be an error, not an empty model
+    Py_DECREF(r);
+    return PyError();
+  }
+  buf = p;
   Py_DECREF(r);
   *out_str = buf.c_str();
   return 0;
@@ -279,7 +297,12 @@ int LGBM_TrainBoosterGetEval(BoosterHandle handle, const char** out_str) {
   PyObject* r = Call("booster_get_eval", args);
   Py_DECREF(args);
   if (!r) return PyError();
-  buf = PyUnicode_AsUTF8(r);
+  const char* p = PyUnicode_AsUTF8(r);
+  if (!p) {
+    Py_DECREF(r);
+    return PyError();
+  }
+  buf = p;
   Py_DECREF(r);
   *out_str = buf.c_str();
   return 0;
@@ -304,8 +327,10 @@ int LGBM_TrainBoosterPredictForMat(BoosterHandle handle, const double* data,
   PyObject* r = Call("booster_predict_mat", args);
   Py_DECREF(args);
   if (!r) return PyError();
-  *out_len = PyLong_AsLongLong(r);
+  long long len = PyLong_AsLongLong(r);
   Py_DECREF(r);
+  if (len == -1 && PyErr_Occurred()) return PyError();
+  *out_len = len;
   return 0;
 }
 

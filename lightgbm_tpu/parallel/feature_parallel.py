@@ -66,6 +66,16 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
     f = jax.shard_map(
         inner, mesh=mesh,
         in_specs=(P(None, None), P(None, None), P(axis), P(axis), P(axis),
-                  P(None)),
+                  P(None), P(axis)),
         out_specs=out_specs, check_vma=False)
-    return jax.jit(f)
+
+    def grow(binned, vals, feature_mask, num_bin, na_bin, na_bin_part=None,
+             is_cat=None):
+        if na_bin_part is None:
+            na_bin_part = na_bin
+        if is_cat is None:
+            is_cat = jnp.zeros(num_bin.shape[0], bool)
+        return f(binned, vals, feature_mask, num_bin, na_bin, na_bin_part,
+                 is_cat)
+
+    return jax.jit(grow)

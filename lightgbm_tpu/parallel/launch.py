@@ -92,10 +92,20 @@ def init(coordinator_address: Optional[str] = None,
         else:
             jax.distributed.initialize()
         init._done = True
-    except (RuntimeError, ValueError):
-        # single-process / already-initialized runtimes: proceed solo, the
-        # same way the reference CLI falls back to serial when
-        # num_machines=1
+    except (RuntimeError, ValueError) as e:
+        if coordinator_address is not None:
+            # an explicitly-requested multi-host launch failing must be
+            # loud: silently degrading to single-process would later hang
+            # in collectives or fit divergent bin mappers per host
+            raise RuntimeError(
+                f"jax.distributed.initialize failed for explicit "
+                f"coordinator {coordinator_address!r}: {e}") from e
+        # auto-detect path on single-process / already-initialized
+        # runtimes: proceed solo, the same way the reference CLI falls
+        # back to serial when num_machines=1 — but say so
+        from ..utils.log import Log
+        Log.warning(f"jax.distributed auto-init unavailable ({e}); "
+                    "continuing single-process")
         init._done = True
 
 

@@ -65,7 +65,10 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
-        hist_reduce=vote_reduce, subtract=False, jit=False)
+        hist_reduce=vote_reduce, subtract=False,
+        count_reduce=lambda c: lax.pmax(c, axis),
+        # root totals must NOT come through the vote-filtered histogram
+        sum_reduce=lambda t: lax.psum(t, axis), jit=False)
 
     out_specs = TreeArrays(
         num_leaves=P(), split_feature=P(), threshold_bin=P(),
@@ -76,6 +79,12 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
 
     f = jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(), P(), P()),
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
         out_specs=out_specs, check_vma=False)
-    return jax.jit(f)
+
+    def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
+        if is_cat is None:
+            is_cat = jnp.zeros(num_bin.shape[0], bool)
+        return f(binned, vals, feature_mask, num_bin, na_bin, na_bin, is_cat)
+
+    return jax.jit(grow)

@@ -107,3 +107,37 @@ class TestEFBTraining:
         b2 = lgb.train({"objective": "binary", "verbosity": -1}, ds2,
                        num_boost_round=5)
         np.testing.assert_allclose(b1.predict(x), b2.predict(x), rtol=1e-5)
+
+
+class TestEFBMaskedLearner:
+    """EFB on the masked (TPU-default) learner: group-space histograms +
+    search-time expansion must be lossless (VERDICT round-1 gap: EFB was
+    partitioned-only, leaving wide sparse data uncompressed on TPU)."""
+
+    def test_lossless_vs_unbundled_masked(self):
+        x, y = _onehot_data()
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "min_data_in_leaf": 20, "tpu_learner": "masked"}
+        b1 = lgb.train({**params, "enable_bundle": True},
+                       lgb.Dataset(x, label=y), num_boost_round=10)
+        b2 = lgb.train({**params, "enable_bundle": False},
+                       lgb.Dataset(x, label=y), num_boost_round=10)
+        assert b1._model._use_efb
+        # measured width reduction of the device-resident matrix
+        assert b1._model.binned_dev.shape[1] < x.shape[1]
+        np.testing.assert_allclose(b1.predict(x), b2.predict(x),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_masked_matches_partitioned_with_efb(self):
+        x, y = _onehot_data(seed=9)
+        x = x.copy()
+        x[::17, 0] = np.nan   # exercise the NaN bin through bundle decode
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "min_data_in_leaf": 20, "enable_bundle": True}
+        bm = lgb.train({**params, "tpu_learner": "masked"},
+                       lgb.Dataset(x, label=y), num_boost_round=10)
+        bp = lgb.train({**params, "tpu_learner": "partitioned"},
+                       lgb.Dataset(x, label=y), num_boost_round=10)
+        assert bm._model._use_efb and bp._model._use_efb
+        np.testing.assert_allclose(bm.predict(x), bp.predict(x),
+                                   rtol=1e-4, atol=1e-5)
