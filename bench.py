@@ -3,15 +3,25 @@
 Mirrors the reference's headline experiment (docs/Experiments.rst: HIGGS,
 500 iterations, num_leaves=255 -> 130.094 s on 2x E5-2690v4, i.e. 3.843
 iters/s; GPU docs recommend 63 bins for accelerator runs,
-docs/GPU-Performance.rst:108-124).  This benches a 1M-row slice of that
-shape; ``vs_baseline`` is our steady-state iters/s over the reference's
-full-size 3.843 iters/s.
+docs/GPU-Performance.rst:108-124).
 
-Robustness (round-1 postmortem: one TPU-claim hiccup lost the round's perf
-signal): the measurement runs in a CHILD process; the parent retries with
-backoff on failure, falls back to a reduced CPU run as a last resort, and
-ALWAYS prints exactly one JSON line
-{"metric", "value", "unit", "vs_baseline"[, "error"]}.
+Primary metric (round-over-round comparable): steady-state iters/s on a
+1M-row slice at 31 leaves / 63 bins; ``vs_baseline`` is against the
+reference's full-size 3.843 iters/s.  ``extra`` carries the baseline-shaped
+points VERDICT r2 asked for: a 255-leaf run and a 10M-row scaling point.
+
+Round-3 perf notes (PROFILE.md): training runs in fused on-device chunks
+(lax.scan over whole iterations, one host sync per chunk — the tunneled
+chip costs ~67 ms per blocking call), and the histogram kernel uses the
+[C, rows] x [rows, F*Bp] orientation with a lane-aligned bin axis.
+Round-2's bench also silently binned at 255 bins (Dataset() without
+params); params are now passed to the Dataset constructor.
+
+Robustness: the measurement runs in a CHILD process; the parent retries
+with backoff on failure (shrinking timeouts — an unbounded retry ladder
+can eat the round's budget, ADVICE r2), falls back to a reduced CPU run as
+a last resort, and ALWAYS prints exactly one JSON line
+{"metric", "value", "unit", "vs_baseline"[, "extra"][, "error"]}.
 """
 
 import json
@@ -25,12 +35,11 @@ import numpy as np
 BASELINE_IPS = 500.0 / 130.094  # reference HIGGS CPU (Experiments.rst:113)
 METRIC = "higgs1m_binary_train_iters_per_sec"
 N_ROWS, N_FEAT = 1_000_000, 28
-ITERS = 100
+PRIMARY_LEAVES, PRIMARY_MAX_BIN = 31, 63
+PRIMARY_PADDED_BIN = 64          # ops/histogram.py pads the bin axis to 64
 
-# bf16/f32 MXU peak per chip for MFU estimate (How-to-Scale-Your-Model
-# hardware tables); unknown kinds report FLOP/s only.
+# bf16/f32 MXU peak per chip for MFU estimate; unknown kinds report FLOP/s.
 PEAK_FLOPS = {
-    # device_kind strings normalize like "tpuv5lite" / "tpuv4" etc.
     "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12,
     "v4": 275e12, "v6e": 918e12, "v6lite": 918e12,
 }
@@ -45,110 +54,191 @@ def make_higgs_like(n: int, f: int, seed: int = 0):
     return x, y
 
 
-def child(iters: int) -> None:
-    """The actual measurement; prints the JSON line on success."""
-    x, y = make_higgs_like(N_ROWS, N_FEAT)
+def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None):
+    """Train one config; returns (ips, auc, ds) steady-state over n_chunks
+    fused chunks (or per-iter updates when fusion is unavailable).  Pass
+    ``ds`` to reuse an already-binned dataset (num_leaves is a Booster
+    param; binning is identical across points on the same data)."""
+    params = {
+        "objective": "binary", "num_leaves": num_leaves,
+        "learning_rate": 0.1, "max_bin": PRIMARY_MAX_BIN,
+        "min_data_in_leaf": 20, "verbosity": 0,
+    }
+    t0 = time.time()
+    if ds is None:
+        ds = lgb.Dataset(x, label=y, params=params)
+        ds.construct()
+    t_bin = time.time() - t0
 
-    print("[bench] data ready; importing jax / claiming device...",
+    bst = lgb.Booster(params=dict(params, fused_chunk=chunk),
+                      train_set=ds)
+    m = bst._model
+    fused = m.supports_fused() and chunk > 1
+
+    t0 = time.time()
+    if fused:
+        m.train_chunk(chunk)          # includes XLA compile
+    else:
+        bst.update()
+    np.asarray(m.score)
+    t_compile = time.time() - t0
+
+    t0 = time.time()
+    iters = 0
+    if fused:
+        for _ in range(n_chunks):
+            m.train_chunk(chunk)
+            iters += chunk
+    else:
+        for _ in range(n_chunks * chunk):
+            bst.update()
+            iters += 1
+    np.asarray(m.score)               # hard sync
+    dt = time.time() - t0
+    ips = iters / dt
+
+    from lightgbm_tpu.metrics import _auc
+    auc = _auc(y, np.asarray(m.train_score())[:, 0], None)
+    print(f"[bench] {tag}: bin={t_bin:.1f}s compile+warm={t_compile:.1f}s "
+          f"steady={dt:.1f}s/{iters} iters -> {ips:.3f} iters/s "
+          f"(train-AUC={auc:.4f}, fused={fused})",
           file=sys.stderr, flush=True)
+    return ips, auc, ds
+
+
+def child() -> None:
+    """The actual measurement; prints the JSON line on success."""
+    quick = os.environ.get("_BENCH_QUICK") == "1"
+
+    print("[bench] importing jax / claiming device...", file=sys.stderr,
+          flush=True)
     t_dev = time.time()
     import jax
     devs = jax.devices()
     print(f"[bench] devices={devs} ({time.time() - t_dev:.1f}s)",
           file=sys.stderr, flush=True)
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.metrics import _auc
 
-    num_leaves, max_bin = 31, 63
-    params = {
-        "objective": "binary",
-        "num_leaves": num_leaves,
-        "learning_rate": 0.1,
-        "max_bin": max_bin,
-        "min_data_in_leaf": 20,
-        "verbosity": 0,
+    x, y = make_higgs_like(N_ROWS, N_FEAT)
+
+    # primary: 1M x 28, 31 leaves (round-over-round comparable)
+    ips1, auc1, ds1 = _train_point(lgb, x, y, num_leaves=PRIMARY_LEAVES,
+                                   chunk=4 if quick else 25,
+                                   n_chunks=1 if quick else 4,
+                                   tag="1M/31leaf")
+
+    rec = {
+        "metric": METRIC,
+        "value": round(ips1, 3),
+        "unit": "iters/s (1M rows x 28 feat, 31 leaves, 63 bins)",
+        "vs_baseline": round(ips1 / BASELINE_IPS, 3),
     }
-    t_bin0 = time.time()
-    ds = lgb.Dataset(x, label=y)
-    ds.construct()
-    t_bin = time.time() - t_bin0
+    # emit the primary record NOW: if an extra point wedges and the parent
+    # kills this child, the partial-stdout scan still recovers the primary
+    # (the parent takes the LAST matching line, so a later enriched record
+    # supersedes this one)
+    print(json.dumps(rec), flush=True)
 
-    bst = lgb.Booster(params=params, train_set=ds)
-    # warmup: first iteration includes XLA compilation
-    t0 = time.time()
-    bst.update()
-    t_compile = time.time() - t0
+    extra = {}
+    if not quick:
+        # VERDICT r2 task 3a: the baseline's 255-leaf shape (at 1M rows)
+        try:
+            ips2, auc2, _ = _train_point(lgb, x, y, num_leaves=255, chunk=4,
+                                         n_chunks=2, tag="1M/255leaf",
+                                         ds=ds1)
+            extra["higgs1m_255leaf_iters_per_sec"] = round(ips2, 3)
+            extra["higgs1m_255leaf_auc"] = round(float(auc2), 4)
+        except Exception as e:       # keep the primary JSON alive
+            extra["higgs1m_255leaf_error"] = f"{type(e).__name__}: {e}"[:200]
+        # VERDICT r2 task 3b: 10M-row scaling point (31 leaves)
+        try:
+            x10 = np.concatenate([x] * 10, axis=0)
+            rng = np.random.RandomState(7)
+            for i in range(10):     # chunked f32 noise: no 2 GB f64 spike
+                sl = slice(i * N_ROWS, (i + 1) * N_ROWS)
+                x10[sl] += (rng.standard_normal(
+                    (N_ROWS, N_FEAT)).astype(np.float32) * 1e-3)
+            y10 = np.concatenate([y] * 10)
+            ips3, auc3, _ = _train_point(lgb, x10, y10, num_leaves=31,
+                                         chunk=8, n_chunks=2,
+                                         tag="10M/31leaf")
+            extra["higgs10m_iters_per_sec"] = round(ips3, 3)
+            extra["higgs10m_auc"] = round(float(auc3), 4)
+        except Exception as e:
+            extra["higgs10m_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    t1 = time.time()
-    for i in range(iters - 1):
-        bst.update()
-        if (i + 1) % 20 == 0:
-            print(f"[bench] iter {i + 1}/{iters - 1} "
-                  f"({(i + 1) / (time.time() - t1):.2f} iters/s)",
-                  file=sys.stderr, flush=True)
-    # force device sync
-    np.asarray(bst._model.score)
-    dt = time.time() - t1
-    ips = (iters - 1) / dt
-
-    # observability: achieved histogram FLOP/s + MFU estimate.  Dominant
-    # work per iteration is the one-hot-matmul histogram pass per split:
-    # [3, N] @ [N, F*B] = 2*3*N*F*B FLOPs, (num_leaves-1) splits/tree
-    # (subtraction trick already halves what a naive build would do).
-    hist_flops_per_iter = 2.0 * 3 * N_ROWS * N_FEAT * max_bin * (num_leaves - 1)
-    achieved = hist_flops_per_iter * ips
+    # observability: achieved histogram FLOP/s + MFU estimate for the
+    # primary point (one-hot contraction, (num_leaves-1) passes/iter)
+    hist_flops = (2.0 * 3 * N_ROWS * N_FEAT * PRIMARY_PADDED_BIN
+                  * (PRIMARY_LEAVES - 1))
+    achieved = hist_flops * ips1
     kind = devs[0].device_kind.lower().replace(" ", "")
     peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)
     mfu = f"{achieved / peak:.1%}" if peak else "n/a"
-    auc = _auc(y, np.asarray(bst._model.train_score())[:, 0], None)
-    print(f"[bench] bin={t_bin:.1f}s compile+iter1={t_compile:.1f}s "
-          f"steady={dt:.1f}s for {iters - 1} iters -> {ips:.2f} iters/s "
-          f"train-AUC={auc:.4f} hist~{achieved / 1e12:.2f} TFLOP/s "
-          f"(MFU~{mfu} of {devs[0].device_kind})", file=sys.stderr)
+    print(f"[bench] primary {ips1:.2f} iters/s train-AUC={auc1:.4f} "
+          f"hist~{achieved / 1e12:.2f} TFLOP/s (MFU~{mfu} of "
+          f"{devs[0].device_kind})", file=sys.stderr)
 
-    print(json.dumps({
-        "metric": METRIC,
-        "value": round(ips, 3),
-        "unit": "iters/s (1M rows x 28 feat, 31 leaves, 63 bins)",
-        "vs_baseline": round(ips / BASELINE_IPS, 3),
-    }), flush=True)
+    if extra:
+        if "higgs1m_255leaf_iters_per_sec" in extra:
+            extra["higgs1m_255leaf_vs_baseline"] = round(
+                extra["higgs1m_255leaf_iters_per_sec"] / BASELINE_IPS, 3)
+        rec["extra"] = extra
+        print(json.dumps(rec), flush=True)
 
 
-def run_child(extra_env, iters: int, timeout: int):
-    env = dict(os.environ, _BENCH_CHILD="1", _BENCH_ITERS=str(iters))
+def _last_metric_line(stdout: str):
+    """Last (most-enriched) JSON metric line, or None."""
+    found = None
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and METRIC in line:
+            found = line
+    return found
+
+
+def run_child(extra_env, timeout: int):
+    env = dict(os.environ, _BENCH_CHILD="1")
     env.update(extra_env)
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, capture_output=True, text=True,
                            timeout=timeout)
     except subprocess.TimeoutExpired as e:
-        # TimeoutExpired.stderr is bytes even under text=True
-        err_txt = (e.stderr.decode(errors="replace")
-                   if isinstance(e.stderr, bytes) else (e.stderr or ""))
-        sys.stderr.write(err_txt[-2000:])
+        def _txt(b):
+            return (b.decode(errors="replace") if isinstance(b, bytes)
+                    else (b or ""))
+        sys.stderr.write(_txt(e.stderr)[-2000:])
+        # the child prints the primary record before the optional extra
+        # points — a hang in an extra must not discard the primary
+        line = _last_metric_line(_txt(e.stdout))
+        if line:
+            return line, None
         return None, f"timeout after {timeout}s"
     sys.stderr.write(r.stderr[-4000:] if r.stderr else "")
-    for line in (r.stdout or "").splitlines():
-        line = line.strip()
-        if line.startswith("{") and METRIC in line:
-            return line, None
+    line = _last_metric_line(r.stdout)
+    if line:
+        return line, None
     return None, f"rc={r.returncode}, no JSON line"
 
 
 def main():
     if os.environ.get("_BENCH_CHILD"):
-        child(int(os.environ.get("_BENCH_ITERS", ITERS)))
+        child()
         return
 
     errors = []
-    # attempt 1-3: the default backend (TPU when available), with backoff —
-    # transient tunnel/claim failures were the round-1 failure mode
-    for attempt, backoff in enumerate((0, 20, 60)):
+    # shrinking timeouts (ADVICE r2: a fixed 2400s ladder could eat the
+    # round's budget); later attempts drop the extra points via _BENCH_QUICK
+    for attempt, (backoff, timeout, env) in enumerate((
+            (0, 2400, {}),
+            (20, 1200, {"_BENCH_QUICK": "1"}),
+            (60, 900, {"_BENCH_QUICK": "1"}))):
         if backoff:
             print(f"[bench] retrying in {backoff}s...", file=sys.stderr,
                   flush=True)
             time.sleep(backoff)
-        line, err = run_child({}, ITERS, timeout=2400)
+        line, err = run_child(env, timeout=timeout)
         if line:
             print(line, flush=True)
             return
@@ -156,9 +246,9 @@ def main():
         print(f"[bench] attempt {attempt + 1} failed: {err}", file=sys.stderr,
               flush=True)
 
-    # last resort: reduced-iteration CPU run — an honest degraded number
-    # beats no number
-    line, err = run_child({"JAX_PLATFORMS": "cpu"}, 12, timeout=2400)
+    # last resort: reduced CPU run — an honest degraded number beats none
+    line, err = run_child({"JAX_PLATFORMS": "cpu", "_BENCH_QUICK": "1"},
+                          timeout=600)
     if line:
         rec = json.loads(line)
         rec["error"] = ("degraded: accelerator unavailable, CPU fallback; "

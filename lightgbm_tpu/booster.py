@@ -137,6 +137,21 @@ class Booster:
         self._sync_trees()
         return stopped
 
+    def update_chunk(self, k: int) -> bool:
+        """Run ``k`` iterations fused in one device program (one host
+        round trip per chunk — see GBDTModel.train_chunk).  Caller must
+        have checked ``supports_fused()``; returns True if training hit a
+        no-split iteration."""
+        stopped = self._model.train_chunk(k)
+        self._sync_trees()
+        return stopped
+
+    def supports_fused(self) -> bool:
+        return (self._model is not None
+                and hasattr(self._model, "supports_fused")
+                and self._model.supports_fused()
+                and not self._model.valid_sets)
+
     def rollback_one_iter(self) -> "Booster":
         self._model.rollback_one_iter()
         self._sync_trees()

@@ -160,13 +160,18 @@ _PARAMS: Dict[str, tuple] = {
     "gpu_use_dp": (bool, False, []),
     "num_gpu": (int, 1, []),
     # ---- TPU-specific (new axis, cf. SURVEY.md §1 device dimension) ----
-    "mesh_shape": (list, None, []),          # e.g. [8] or [4, 2]
-    "mesh_axis_names": (list, None, []),     # e.g. ["data"] or ["data", "feature"]
+    "mesh_shape": (list, None, []),          # one axis, e.g. [8]
+    "mesh_axis_names": (list, None, []),     # one axis, e.g. ["data"]
     "hist_dtype": (str, "float32", []),      # histogram accumulation dtype
     # auto: partitioned on CPU, masked (one jitted program per tree) on
     # accelerators where per-split host round-trips dominate
     "tpu_learner": (str, "auto", []),  # auto | partitioned | masked
     "rows_per_block": (int, 0, []),          # 0 = auto-tune histogram row blocking
+    # iterations fused into one on-device program (lax.scan) when the
+    # objective/bagging config allows it — amortizes the host<->device
+    # round-trip (measured ~67 ms on a tunneled chip) over the chunk.
+    # 0/1 disables fusion.
+    "fused_chunk": (int, 25, []),
     "use_pallas": (bool, True, []),          # use Pallas kernels where available
     # ---- IO / task ----
     "task": (str, "train", ["task_type"]),

@@ -199,10 +199,38 @@ class Dataset:
         self.efb: Optional[EFBInfo] = None  # set when bundling merged columns
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _bin_signature(cfg: Config) -> dict:
+        """The config fields that shape binning — a mismatch after
+        construction means training would silently use stale bins
+        (round-2's bench measured 255-bin histograms while reporting 63)."""
+        return {
+            "max_bin": cfg.max_bin,
+            "min_data_in_bin": cfg.min_data_in_bin,
+            "bin_construct_sample_cnt": cfg.bin_construct_sample_cnt,
+            "max_bin_by_feature": tuple(cfg.max_bin_by_feature or ()),
+            "enable_bundle": cfg.enable_bundle,
+            "categorical_feature": cfg.categorical_feature,
+            "use_missing": cfg.use_missing,
+            "zero_as_missing": cfg.zero_as_missing,
+        }
+
     def construct(self, config: Optional[Config] = None) -> "Dataset":
         if self._constructed:
+            # reference parity (basic.py "Ignoring params... dataset already
+            # constructed"): binning params cannot change after construction
+            # — warn loudly instead of silently training on the old bins
+            built = getattr(self, "_built_bin_sig", None)
+            if config is not None and built is not None \
+                    and self._bin_signature(config) != built:
+                from .utils.log import Log
+                Log.warning(
+                    "Ignoring binning params passed at train time: "
+                    f"Dataset was already constructed with {built}; pass "
+                    "params to the Dataset constructor instead")
             return self
         cfg = config or Config(self.params)
+        self._built_bin_sig = self._bin_signature(cfg)
         if _is_seq_input(self._raw_input):
             return self._construct_from_seqs(cfg)
         sparse_in = _is_scipy_sparse(self._raw_input)

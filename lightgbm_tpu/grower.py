@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .ops.histogram import compute_histogram, hist_block_rows
+from .ops.histogram import compute_histogram
 from .ops.split import SplitParams, SplitResult, find_best_split, leaf_output
 
 
@@ -99,7 +99,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 hist_view: Optional[Callable] = None,
                 select_best: Optional[Callable] = None,
                 subtract: bool = True,
-                gather: bool = True, min_gather_rows: int = 4096,
+                gather: bool = False, min_gather_rows: int = 4096,
                 count_reduce: Optional[Callable] = None,
                 sum_reduce: Optional[Callable] = None,
                 efb=None,
@@ -125,7 +125,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       (serial_tree_learner.cpp:283-323 smaller-leaf discipline;
       cuda_histogram_constructor's leaf-indexed construction) instead of a
       full-N masked pass.  Below ``min_gather_rows`` tiers stop (compile
-      cost isn't worth it).
+      cost isn't worth it).  DEFAULT OFF: measured on TPU v5e (PROFILE.md)
+      XLA's row gather costs ~22 ns/row and ``nonzero`` ~3 ms/1M rows, so
+      the tiered path is ~2.4x SLOWER than the masked full pass it tries
+      to avoid; it also multiplies compile time by the tier count.
     - count_reduce: makes the tier choice uniform across shards (pmax over
       the mesh axis) so collectives inside the switch stay congruent; must
       be set whenever hist_reduce crosses shards.

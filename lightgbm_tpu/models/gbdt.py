@@ -127,6 +127,17 @@ class GBDTModel:
         # one-program grower (parallel/{data,feature,voting}_parallel.py).
         dist = config.tree_learner \
             if config.tree_learner in ("data", "feature", "voting") else None
+        if dist is not None and hist_reduce is not None:
+            # can't raise: num_machines>1 auto-promotes serial->data in
+            # Config, so multi-host callers using the hook pattern never
+            # asked for a distributed learner explicitly — warn and keep
+            # the (previously silent) hook path
+            from ..utils.log import Log
+            Log.warning(
+                f"ignoring tree_learner={dist}: a caller-supplied "
+                "hist_reduce hook takes over cross-shard reduction")
+        self._custom_hist_reduce = hist_reduce is not None
+        self._fused_cache: Dict[str, object] = {}
         self._mesh = None
         self._row_pad = 0
         self._feat_pad = 0
@@ -258,10 +269,6 @@ class GBDTModel:
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
                 block_rows=config.rows_per_block, hist_reduce=hist_reduce,
-                # a caller-supplied cross-shard hook comes without a
-                # count_reduce, so gather tiers could pick divergent
-                # switch branches per shard -> keep the full-pass path
-                gather=hist_reduce is None,
                 efb=self.efb_dev if self._use_efb else None)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
@@ -441,6 +448,14 @@ class GBDTModel:
         from ..parallel import make_mesh
         from ..utils.log import Log
         devs = jax.devices()
+        if config.mesh_shape and len(config.mesh_shape) > 1:
+            # the tree learners shard exactly one axis (rows OR features);
+            # a multi-dim mesh has no meaning here, so reject it loudly
+            # rather than silently flattening
+            raise ValueError(
+                f"mesh_shape={config.mesh_shape}: tree_learner="
+                f"{config.tree_learner} shards a single axis; pass a "
+                "one-element mesh_shape (e.g. [8])")
         if config.mesh_shape:
             n = int(np.prod(config.mesh_shape))
         elif config.num_machines > 1:
@@ -554,9 +569,12 @@ class GBDTModel:
             self._bag_mask = mask.astype(np.float32)
         return self._bag_mask
 
-    def _goss_vals(self, g: jax.Array, h: jax.Array) -> jax.Array:
+    def _goss_vals(self, g: jax.Array, h: jax.Array,
+                   it: Optional[jax.Array] = None) -> jax.Array:
         """GOSS (goss.hpp:20-188): keep top_rate by |grad|, sample
-        other_rate of the rest, amplify their weight."""
+        other_rate of the rest, amplify their weight.  ``it`` may be a
+        traced iteration index (fused-chunk path); defaults to the host
+        counter so both paths draw identical per-iteration keys."""
         cfg = self.config
         n = self.num_data
         top_k = max(1, int(n * cfg.top_rate))
@@ -565,7 +583,9 @@ class GBDTModel:
         absg = jnp.abs(g) * h
         thresh = -jnp.sort(-absg)[top_k - 1]
         is_top = absg >= thresh
-        key = jax.random.PRNGKey(cfg.bagging_seed + self.iter_)
+        if it is None:
+            it = self.iter_
+        key = jax.random.PRNGKey(cfg.bagging_seed + it)
         u = jax.random.uniform(key, (n,))
         p_other = other_k / jnp.maximum(n - top_k, 1)
         is_other = (~is_top) & (u < p_other)
@@ -588,6 +608,153 @@ class GBDTModel:
 
     def _score_for_gradients(self) -> jax.Array:
         return self.score
+
+    # -- fused multi-iteration path (the tunnel-latency killer) ------------
+    def _fusable_config(self) -> bool:
+        """Whether this model/objective/sampling combination has fused-path
+        semantics (independent of whether fusion is enabled) — also gates
+        the f32 leaf-shrinkage in train_one_iter so toggling ``fused_chunk``
+        never changes the trained model."""
+        cfg = self.config
+        host_bagging = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+        return (type(self) is GBDTModel
+                and self.objective is not None
+                and not self.objective.need_renew_tree_output
+                and not self.objective.host_state_per_iter
+                and self.num_class == 1
+                and not cfg.linear_tree
+                and self._learner_kind == "masked"
+                and self._dist is None
+                and not self._custom_hist_reduce
+                and not host_bagging
+                and self._forced_spec is None
+                and self._cegb_state is None)
+
+    def supports_fused(self) -> bool:
+        """True when whole iterations can run fused on device via
+        ``lax.scan``: pure-JAX gradients -> grow -> leaf-gather score
+        update, with ONE host round trip per chunk instead of ~5 per
+        iteration.  PROFILE.md measured ~67 ms per blocking call on the
+        tunneled chip, so the per-iteration path pays ~335 ms/iter of pure
+        latency; the reference's cuda_exp learner syncs once per TREE
+        (cuda_single_gpu_tree_learner.cpp:108-232) — this syncs once per
+        CHUNK of trees."""
+        return self.config.fused_chunk > 1 and self._fusable_config()
+
+    def _fused_chunk_fn(self):
+        fn = self._fused_cache.get("chunk")
+        if fn is None:
+            import functools
+            cfg = self.config
+            grow = make_grower(
+                num_leaves=cfg.num_leaves, num_bins=self.max_bin,
+                params=self.split_params, max_depth=cfg.max_depth,
+                block_rows=cfg.rows_per_block,
+                efb=self.efb_dev if self._use_efb else None, jit=False)
+            obj = self.objective
+            lr = jnp.float32(self.learning_rate)
+            use_goss = self._goss
+            ic = self._ic_grow
+
+            def one_iter(carry, xs):
+                score, dead = carry
+                fmask, it = xs
+                g, h = obj.get_gradients(score[:, 0])
+                w = self._goss_vals(g, h, it) if use_goss \
+                    else jnp.ones_like(g)
+                vals = jnp.stack([g * w, h * w, w], axis=1)
+                kw = {"is_cat": ic} if ic is not None else {}
+                arrays = grow(self.binned_dev, vals, fmask,
+                              self._nb_grow, self._na_grow, **kw)
+                lv = arrays.leaf_value * lr
+                # per-iteration semantics stop training at the FIRST
+                # no-split tree (gbdt.cpp "no more leaves..."); once dead,
+                # later scan iterations must contribute nothing, even if a
+                # different feature mask could have split (the host loop
+                # discards their tree records)
+                ok = jnp.where(dead, 0.0,
+                               (arrays.num_leaves > 1).astype(jnp.float32))
+                dead = dead | (arrays.num_leaves <= 1)
+                delta = jnp.take(lv, arrays.leaf_of_row) * ok
+                score = score.at[:, 0].add(delta)
+                # keep the scan outputs tree-sized: drop the [N] row->leaf
+                # vector, ship shrunk leaf values
+                out = arrays._replace(leaf_of_row=jnp.zeros((), jnp.int32),
+                                      leaf_value=lv)
+                return (score, dead), out
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def chunk(score, fmasks, iters):
+                (score, _), out = jax.lax.scan(
+                    one_iter, (score, jnp.bool_(False)), (fmasks, iters))
+                return score, out
+
+            fn = self._fused_cache["chunk"] = chunk
+        return fn
+
+    def train_chunk(self, k: int) -> bool:
+        """Run ``k`` boosting iterations as ONE device program + ONE host
+        fetch of the k small tree records.  Semantically identical to k
+        ``train_one_iter`` calls under ``supports_fused()`` (same RNG
+        streams: feature masks are pre-drawn host-side, GOSS keys are
+        seeded by iteration index in-graph).  Returns True when a
+        no-split iteration occurred (trailing stump repeats discarded)."""
+        if self.valid_sets:
+            raise ValueError("train_chunk requires no validation sets")
+        if not self._fusable_config():
+            raise ValueError(
+                "train_chunk: this model/objective/sampling configuration "
+                "is not fusable (check supports_fused() before calling)")
+        cfg = self.config
+        start_iter = self.iter_
+        init0 = 0.0
+        if start_iter == 0 and self.objective is not None \
+                and cfg.boost_from_average and not self._init_applied:
+            init0 = self.objective.boost_from_score(0)
+            self._init_scores = [init0]
+            if init0 != 0.0:
+                self.score = self.score + jnp.float32(init0)
+
+        chunk = self._fused_chunk_fn()
+        if cfg.feature_fraction < 1.0:
+            fmasks = jnp.asarray(
+                np.stack([self._feature_mask() for _ in range(k)]))
+        else:
+            fmasks = jnp.ones((k, self.num_features), bool)
+        iters = jnp.arange(start_iter, start_iter + k, dtype=jnp.int32)
+        self.score, stacked = chunk(self.score, fmasks, iters)
+        host = jax.device_get(stacked)          # the one sync per chunk
+
+        lr = self.learning_rate
+        stopped = False
+        for j in range(k):
+            tj = TreeArrays(*(np.asarray(fld[j]) for fld in host))
+            nl = int(tj.num_leaves)
+            lvj = np.asarray(tj.leaf_value, np.float64).copy()
+            if nl <= 1:
+                stopped = True
+                lvj[:] = 0.0
+            ht = Tree.from_arrays(tj, self.train_set.used_features,
+                                  self.train_set.bin_mappers)
+            ht.internal_value = ht.internal_value * lr
+            ht.shrinkage = lr
+            bias = init0 if (start_iter == 0 and j == 0) else 0.0
+            ht.leaf_value = lvj[:max(nl, 1)] + bias   # Tree::AddBias
+            self.models.append(ht)
+
+            dev_arrays = TreeArrays(*(fld[j] for fld in stacked))
+            dev_lv = dev_arrays.leaf_value if nl > 1 else \
+                jnp.zeros(cfg.num_leaves, jnp.float32)
+            steps = round_up_pow2(max(ht.max_depth(), 1))
+            self.device_trees.append(_DeviceTree(dev_arrays, dev_lv, steps))
+            self.tree_weights.append(1.0)
+            self.iter_ += 1
+            if stopped:
+                break
+        self._last_iter_state = None    # rollback not supported past a chunk
+        return stopped
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -684,7 +851,18 @@ class GBDTModel:
                         leaf_values[:nl].copy())
 
             shrinkage = 1.0 if cfg.boosting == "rf" else self.learning_rate
-            leaf_values *= shrinkage
+            if self._fusable_config():
+                # shrink with f32 semantics (an exact f64 product of f32
+                # operands rounded back to f32 equals the hardware f32
+                # multiply) so the fused-chunk path, which shrinks on
+                # device, yields bit-identical leaf values and scores
+                leaf_values = (leaf_values
+                               * np.float64(np.float32(shrinkage))
+                               ).astype(np.float32).astype(np.float64)
+            else:
+                # DART/RF/multiclass/renew configs can never fuse; keep
+                # the reference's full f64 leaf outputs
+                leaf_values *= shrinkage
             # device trees carry UNBIASED values when the bias was already
             # added to the scorers (gbdt); RF folds the bias into every tree
             # (rf.hpp:137) so its device values include it too
@@ -760,6 +938,12 @@ class GBDTModel:
     def rollback_one_iter(self) -> None:
         """GBDT::RollbackOneIter (gbdt.cpp:451)."""
         if self.iter_ == 0 or self._last_iter_state is None:
+            if self.iter_ > 0:
+                from ..utils.log import Log
+                Log.warning(
+                    "rollback_one_iter: no per-iteration state to roll "
+                    "back (last iterations ran as a fused chunk; set "
+                    "fused_chunk=0 if rollback is needed)")
             return
         st = self._last_iter_state
         for k in range(self.num_class):

@@ -32,6 +32,10 @@ class ObjectiveFunction:
     is_ranking = False
     num_model_per_iteration = 1
     need_renew_tree_output = False
+    # True when get_gradients advances host-side state per call (e.g. a
+    # host RNG counter): such objectives cannot be traced once and scanned
+    # (the fused-chunk path would freeze one draw for all iterations)
+    host_state_per_iter = False
 
     def __init__(self, config: Config):
         self.config = config
@@ -522,6 +526,7 @@ class RankXENDCG(ObjectiveFunction):
     loss with per-iteration randomized relevance transform."""
     name = "rank_xendcg"
     is_ranking = True
+    host_state_per_iter = True   # per-iteration gamma draw via host counter
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)

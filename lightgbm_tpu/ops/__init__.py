@@ -1,2 +1,2 @@
-from .histogram import compute_histogram, hist_block_rows
+from .histogram import compute_histogram, hist_block_rows, HIST_BLOCK_ROWS
 from .split import find_best_split, SplitParams

@@ -46,23 +46,20 @@ def shard_rows(mesh: Mesh, arr, axis: str = "data"):
 
 def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    params: SplitParams, max_depth: int = -1,
-                   block_rows: int = 0, axis: str = "data",
-                   min_gather_rows: int = 4096):
+                   block_rows: int = 0, axis: str = "data"):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
     Inputs: binned [N, F] and vals [N, 3] sharded on rows; feature metadata
     replicated.  Output tree arrays are replicated; ``leaf_of_row`` stays
-    row-sharded.
+    row-sharded.  Child histograms use the masked full pass (gather tiers
+    measured slower on TPU — PROFILE.md §2), which also keeps every shard's
+    collective schedule trivially congruent.
     """
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
         hist_reduce=lambda h: lax.psum(h, axis),
-        # tier choice must be uniform across shards so the psum inside the
-        # gather switch stays congruent (worst-shard capacity via pmax)
-        count_reduce=lambda c: lax.pmax(c, axis),
-        sum_reduce=lambda t: lax.psum(t, axis),
-        min_gather_rows=min_gather_rows, jit=False)
+        sum_reduce=lambda t: lax.psum(t, axis), jit=False)
 
     out_specs = TreeArrays(
         num_leaves=P(), split_feature=P(), threshold_bin=P(),

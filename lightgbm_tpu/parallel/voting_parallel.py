@@ -66,7 +66,6 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
         hist_reduce=vote_reduce, subtract=False,
-        count_reduce=lambda c: lax.pmax(c, axis),
         # root totals must NOT come through the vote-filtered histogram
         sum_reduce=lambda t: lax.psum(t, axis), jit=False)
 

@@ -72,15 +72,13 @@ class TestGatherTiers:
 
     @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
     def test_dp_tiers_match_serial(self):
-        # data-parallel: pmax-uniform tier choice keeps the psum inside the
-        # gather switch congruent across shards
+        # data-parallel (masked full pass) must match the serial grower
         binned, vals = _data(8192)
         b, L = 32, 15
         t_ser = _grow(binned, vals, gather=False)
         mesh = make_mesh((8,), ("data",))
         dp = make_dp_grower(mesh, num_leaves=L, num_bins=b,
-                            params=SplitParams(min_data_in_leaf=5),
-                            min_gather_rows=128)
+                            params=SplitParams(min_data_in_leaf=5))
         f = binned.shape[1]
         t_dp = dp(shard_rows(mesh, binned), shard_rows(mesh, vals),
                   jnp.ones(f, bool), jnp.full(f, b, jnp.int32),

@@ -1,0 +1,157 @@
+"""Microbenchmark histogram formulations on the real TPU.
+
+The chip is behind a tunnel with a ~30-70 ms per-call latency floor, so each
+variant is applied R times IN-GRAPH (chained through a dummy dependency) and
+we report device-time-per-pass = wall / R.
+
+Run: python tools/bench_hist.py [n_rows] [R]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def amortized(make_one, R):
+    """make_one(binned, vals, salt) -> [F, B, 3]; returns jitted R-rep fn."""
+    @jax.jit
+    def rep(binned, vals):
+        def body(i, acc):
+            # salt the vals with i so XLA can't hoist the pass out of the loop
+            h = make_one(binned, vals + (i * 1e-12), i)
+            return acc + h
+        return lax.fori_loop(0, R, body, jnp.zeros_like(make_one(binned, vals, 0)))
+    return rep
+
+
+def timeit(fn, *args, reps=3):
+    jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def hist_variant(block_rows, dtype, orient, num_bins, f):
+    def one(binned, vals, salt):
+        n = binned.shape[0]
+        pad = (-n) % block_rows
+        if pad:
+            binned = jnp.pad(binned, ((0, pad), (0, 0)))
+            vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        nblocks = (n + pad) // block_rows
+        binned_b = binned.reshape(nblocks, block_rows, f)
+        vals_b = vals.reshape(nblocks, block_rows, 3)
+        iota = jnp.arange(num_bins, dtype=jnp.int32)
+
+        def body(acc, chunk):
+            bins_blk, vals_blk = chunk
+            onehot = (bins_blk.astype(jnp.int32)[:, :, None] == iota) \
+                .astype(dtype).reshape(block_rows, f * num_bins)
+            if orient == "fb3":
+                h = lax.dot_general(
+                    onehot, vals_blk.astype(dtype),
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            else:
+                h = lax.dot_general(
+                    vals_blk.astype(dtype), onehot,
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32).T
+            return acc + h, None
+
+        acc0 = jnp.zeros((f * num_bins, 3), dtype=jnp.float32)
+        acc, _ = lax.scan(body, acc0, (binned_b, vals_b))
+        return acc.reshape(f, num_bins, 3)
+    return one
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    R = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    f, B = 28, 64
+    rng = np.random.RandomState(0)
+    binned = jnp.asarray(rng.randint(0, B, size=(n, f), dtype=np.uint8))
+    vals = jnp.asarray(rng.randn(n, 3).astype(np.float32))
+    jax.block_until_ready((binned, vals))
+    print(f"n={n} f={f} B={B} R={R}; flops/pass = {2*3*n*f*B/1e9:.1f} GFLOP",
+          file=sys.stderr, flush=True)
+
+    ref = None
+    for block in (888, 8192, 32768, 131072):
+        for dtype, dname in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
+            for orient in ("fb3", "3fb"):
+                one = hist_variant(block, dtype, orient, B, f)
+                try:
+                    fn = amortized(one, R)
+                    t = timeit(fn, binned, vals) / R
+                    out = np.asarray(one(jnp.asarray(binned), vals, 0))
+                    if ref is None:
+                        ref = out
+                    err = np.abs(out - ref).max() / max(np.abs(ref).max(), 1)
+                    gfs = 2 * 3 * n * f * B / t / 1e12
+                    print(f"block={block:7d} {dname:4s} {orient}: "
+                          f"{t*1e3:8.2f} ms/pass  {gfs:6.2f} TF/s  "
+                          f"relerr={err:.2e}", file=sys.stderr, flush=True)
+                except Exception as e:
+                    print(f"block={block:7d} {dname:4s} {orient}: FAIL "
+                          f"{type(e).__name__}: {str(e)[:100]}",
+                          file=sys.stderr, flush=True)
+
+    # child-pass strategies at 25% occupancy
+    leaf_of_row = jnp.asarray((rng.rand(n) < 0.25).astype(np.int32))
+    cap = max(1 << int(np.ceil(np.log2(max(n // 4, 1)))), 8)
+    base = hist_variant(8192, jnp.float32, "fb3", B, f)
+
+    def masked_one(binned, vals, salt):
+        m = (leaf_of_row == 1).astype(vals.dtype)[:, None]
+        return base(binned, vals * m, salt)
+
+    def gathered_one(binned, vals, salt):
+        idx = jnp.nonzero(leaf_of_row == 1, size=cap, fill_value=n)[0]
+        safe = jnp.minimum(idx, n - 1)
+        b_g = jnp.take(binned, safe, axis=0)
+        v_g = jnp.take(vals, safe, axis=0) \
+            * (idx < n)[:, None].astype(vals.dtype)
+        return base(b_g, v_g, salt)
+
+    tm = timeit(amortized(masked_one, R), binned, vals) / R
+    tg = timeit(amortized(gathered_one, R), binned, vals) / R
+    print(f"child 25%: masked-full {tm*1e3:.2f} ms vs gather(cap={cap}) "
+          f"{tg*1e3:.2f} ms", file=sys.stderr, flush=True)
+
+    # isolate nonzero / take / partition-style ops
+    def nz_one(binned, vals, salt):
+        idx = jnp.nonzero((leaf_of_row + 0 * salt) == 1, size=cap,
+                          fill_value=n)[0]
+        return idx.astype(jnp.float32).sum().reshape(1, 1, 1) \
+            * jnp.ones((1, 1, 1))
+
+    def take_one(binned, vals, salt):
+        idx = (jnp.arange(cap) * 3 + salt) % n
+        return jnp.take(binned, idx, axis=0).astype(jnp.float32) \
+            .sum().reshape(1, 1, 1)
+
+    def part_one(binned, vals, salt):
+        fcol = jnp.take(binned, 3, axis=1).astype(jnp.int32)
+        go_left = fcol <= (16 + salt * 0)
+        out = jnp.where((leaf_of_row == 1) & (~go_left), 7, leaf_of_row)
+        return out.astype(jnp.float32).sum().reshape(1, 1, 1)
+
+    for name, one in (("nonzero", nz_one), ("take[cap,F]", take_one),
+                      ("partition-update", part_one)):
+        t = timeit(amortized(one, R), binned, vals) / R
+        print(f"  {name}: {t*1e3:.3f} ms", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
