@@ -84,18 +84,19 @@ def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None):
     t_compile = time.time() - t0
 
     t0 = time.time()
-    iters = 0
+    start_iter = m.iter_
     if fused:
         for _ in range(n_chunks):
-            m.train_chunk(chunk)
-            iters += chunk
+            if m.train_chunk(chunk):
+                break                 # no-split stop: count only real iters
     else:
         for _ in range(n_chunks * chunk):
-            bst.update()
-            iters += 1
+            if bst.update():
+                break
     np.asarray(m.score)               # hard sync
     dt = time.time() - t0
-    ips = iters / dt
+    iters = m.iter_ - start_iter
+    ips = iters / max(dt, 1e-9)
 
     from lightgbm_tpu.metrics import _auc
     auc = _auc(y, np.asarray(m.train_score())[:, 0], None)

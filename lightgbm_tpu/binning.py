@@ -30,6 +30,41 @@ class MissingType(enum.Enum):
     NAN = 2
 
 
+def _forced_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int, min_data_in_bin: int,
+                     forced_bounds) -> List[float]:
+    """FindBinWithPredefinedBin analog (src/io/bin.cpp; forced bounds come
+    from ``forcedbins_filename``, dataset_loader.cpp:519-524): the forced
+    upper bounds become mandatory boundaries; the remaining bin budget is
+    distributed over the inter-boundary segments proportionally to their
+    sample counts and filled greedily within each segment."""
+    forced = sorted({float(f) for f in forced_bounds})
+    lo = float(distinct_values[0]) if len(distinct_values) else 0.0
+    hi = float(distinct_values[-1]) if len(distinct_values) else 0.0
+    forced = [f for f in forced if lo <= f < hi][:max(max_bin - 1, 0)]
+    if not forced:
+        return _greedy_find_bin(distinct_values, counts, max_bin, total_cnt,
+                                min_data_in_bin)
+    edges = [-np.inf] + forced + [np.inf]
+    seg_budget_total = max_bin - len(forced)
+    segs = []
+    for i in range(len(edges) - 1):
+        m = (distinct_values > edges[i]) & (distinct_values <= edges[i + 1])
+        segs.append((distinct_values[m], counts[m]))
+    seg_cnts = np.array([int(c.sum()) for _, c in segs], dtype=np.float64)
+    weights = seg_cnts / max(seg_cnts.sum(), 1.0)
+    bounds: List[float] = list(forced)
+    for (vals, cnts), w in zip(segs, weights):
+        if len(vals) == 0:
+            continue
+        b = max(1, int(round(seg_budget_total * w)))
+        sub = _greedy_find_bin(vals, cnts, b, int(cnts.sum()),
+                               min_data_in_bin)
+        bounds.extend(x for x in sub if np.isfinite(x))
+    out = sorted(set(bounds))[:max_bin - 1]
+    return out + [np.inf]
+
+
 def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                      max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
     """Greedy equal-count bin upper bounds over sorted distinct values.
@@ -147,7 +182,12 @@ class BinMapper:
         budget = max_bin - 1 if self.missing_type == MissingType.NAN else max_bin
         budget = max(budget, 2) if len(distinct) > 1 else max(budget, 1)
         total_non_na = int(counts.sum())
-        bounds = _greedy_find_bin(distinct, counts, budget, total_non_na, min_data_in_bin)
+        if forced_bounds:
+            bounds = _forced_find_bin(distinct, counts, budget, total_non_na,
+                                      min_data_in_bin, forced_bounds)
+        else:
+            bounds = _greedy_find_bin(distinct, counts, budget, total_non_na,
+                                      min_data_in_bin)
 
         # make sure zero sits alone in its bin boundary band when present
         # (FindBin carves [-kZeroThreshold, kZeroThreshold] out, bin.cpp)

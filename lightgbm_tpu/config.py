@@ -34,6 +34,12 @@ _PARAMS: Dict[str, tuple] = {
     "num_threads": (int, 0, ["num_thread", "nthread", "nthreads", "n_jobs"]),
     "device_type": (str, "tpu", ["device"]),
     "seed": (int, 0, ["random_seed", "random_state"]),
+    # Honored by design: the functional JAX training path is
+    # deterministic for a fixed config+data+device regardless of this
+    # flag (host RNGs are seeded; XLA reduction order is fixed per
+    # compiled program) — unlike the reference, where it forces
+    # col/row-wise choice to tame OpenMP ordering (config.h:233).
+    # Tested by tests/test_extra_params.py::test_deterministic_by_design.
     "deterministic": (bool, False, []),
     # ---- learning control ----
     "force_col_wise": (bool, False, []),

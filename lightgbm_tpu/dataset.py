@@ -213,6 +213,7 @@ class Dataset:
             "categorical_feature": cfg.categorical_feature,
             "use_missing": cfg.use_missing,
             "zero_as_missing": cfg.zero_as_missing,
+            "forcedbins_filename": cfg.forcedbins_filename,
         }
 
     def construct(self, config: Optional[Config] = None) -> "Dataset":
@@ -445,6 +446,15 @@ class Dataset:
         else:
             sample_col = colfn
         max_bin_by_feature = cfg.max_bin_by_feature
+        forced = {}
+        if getattr(cfg, "forcedbins_filename", ""):
+            # forced bin upper bounds (dataset_loader.cpp:519-524): JSON
+            # list of {"feature": i, "bin_upper_bound": [...]}
+            import json
+            with open(cfg.forcedbins_filename) as fh:
+                for entry in json.load(fh):
+                    forced[int(entry["feature"])] = [
+                        float(v) for v in entry.get("bin_upper_bound", [])]
         self.bin_mappers = []
         for f in range(self.num_total_features):
             m = BinMapper()
@@ -453,7 +463,8 @@ class Dataset:
             m.find_bin(sample_col(f), sample_cnt, mb, cfg.min_data_in_bin,
                        min_split_data=cfg.min_data_in_leaf,
                        pre_filter=cfg.feature_pre_filter, bin_type=bt,
-                       use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing)
+                       use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
+                       forced_bounds=forced.get(f))
             self.bin_mappers.append(m)
         self._finalize_mappers()
 

@@ -103,6 +103,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 count_reduce: Optional[Callable] = None,
                 sum_reduce: Optional[Callable] = None,
                 efb=None,
+                gain_scale=None,
+                extra_trees: bool = False, extra_seed: int = 6,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
@@ -203,15 +205,33 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
         return child_hist
 
-    def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2, is_cat):
+    gscale = None if gain_scale is None else jnp.asarray(gain_scale,
+                                                         jnp.float32)
+
+    def _rand_bins(key, shape, num_bin):
+        """extra_trees (feature_histogram.hpp:116): one random threshold
+        bin per feature, uniform over the feature's valid range."""
+        u = jax.random.uniform(key, shape)
+        span = jnp.maximum(num_bin - 1, 1).astype(jnp.float32)
+        return jnp.minimum((u * span).astype(jnp.int32), num_bin - 2)
+
+    def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2, is_cat,
+               rand2=None):
+        if rand2 is None:
+            return jax.vmap(
+                lambda h, t, po: select_fn(
+                    find_best_split(h, t, num_bin, na_bin, fmask, params,
+                                    po, is_cat, gain_scale=gscale))
+            )(hist2, totals2, parent_out2)
         return jax.vmap(
-            lambda h, t, po: select_fn(
+            lambda h, t, po, rb: select_fn(
                 find_best_split(h, t, num_bin, na_bin, fmask, params, po,
-                                is_cat))
-        )(hist2, totals2, parent_out2)
+                                is_cat, gain_scale=gscale, rand_bin=rb))
+        )(hist2, totals2, parent_out2, rand2)
 
     def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
-                  na_bin_part=None, is_cat=None) -> TreeArrays:
+                  na_bin_part=None, is_cat=None,
+                  rng_iter=None) -> TreeArrays:
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
         f = binned_view.shape[1]
@@ -233,9 +253,23 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         else:
             total0 = vals.sum(axis=0)
         root_out = leaf_output(total0[0], total0[1], params)
+        rb0 = None
+        et_key = None
+        if extra_trees:
+            # key = (extra_seed, iteration, split index): without the
+            # iteration fold every TREE would redraw identical thresholds
+            # and the ExtraTrees decorrelation would be lost entirely
+            et_key = jax.random.PRNGKey(extra_seed)
+            if rng_iter is not None:
+                et_key = jax.random.fold_in(et_key, rng_iter)
+            # the split search runs in (possibly EFB-expanded) feature
+            # space = feature_mask's axis, not binned_view's column count
+            rb0 = _rand_bins(jax.random.fold_in(et_key, 0),
+                             (feature_mask.shape[0],), num_bin)
         res0 = select_fn(find_best_split(_expand(hist0, total0), total0,
                                          num_bin, na_bin, feature_mask,
-                                         params, root_out, is_cat))
+                                         params, root_out, is_cat,
+                                         gain_scale=gscale, rand_bin=rb0))
 
         neg_inf = jnp.float32(-jnp.inf)
         st = _GrowState(
@@ -343,8 +377,12 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 hist2 = jnp.stack([hl_leaf, hl_new])
                 tot2 = jnp.stack([lsum, rsum])
                 po2 = jnp.stack([st.blo[leaf], st.bro[leaf]])
+                rand2 = None
+                if extra_trees:
+                    rand2 = _rand_bins(jax.random.fold_in(et_key, i + 1),
+                                       (2, feature_mask.shape[0]), num_bin)
                 r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
-                            na_bin, feature_mask, po2, is_cat)
+                            na_bin, feature_mask, po2, is_cat, rand2)
                 depth_ok = (max_depth <= 0) | (d < max_depth)
                 g2 = jnp.where(depth_ok, r2.gain, -jnp.inf)
 

@@ -175,7 +175,9 @@ class PartitionedGrower:
                  mono_method: str = "basic", mono_penalty: float = 0.0,
                  interaction_allow: Optional[np.ndarray] = None,
                  bynode_frac: float = 1.0, bynode_seed: int = 0,
-                 efb=None, pool_entries: int = 0):
+                 efb=None, pool_entries: int = 0,
+                 feature_contri: Optional[np.ndarray] = None,
+                 extra_trees: bool = False, extra_seed: int = 6):
         self.L = int(num_leaves)
         self.B = int(num_bins)
         self.params = params
@@ -184,14 +186,32 @@ class PartitionedGrower:
         self.mono = None if mono is None or not np.any(mono) else \
             jnp.asarray(mono, jnp.int32)
         # 'basic' = midpoint range splitting (BasicLeafConstraints);
-        # 'intermediate'/'advanced' = constraints from actual opposite-subtree
+        # 'intermediate' = constraints from actual opposite-subtree
         # outputs, refreshed across the whole frontier after each split
-        # (IntermediateLeafConstraints, monotone_constraints.hpp:514)
+        # (IntermediateLeafConstraints, monotone_constraints.hpp:514).
+        # 'advanced' (AdvancedLeafConstraints, monotone_constraints.hpp:856
+        # — per-threshold cumulative constraint refinement) is not
+        # implemented; it falls back to 'intermediate', which is strictly
+        # MORE conservative: every model it produces satisfies the
+        # constraints, it just forfeits some gain the advanced method
+        # could have recovered.  The fallback is loud, not silent.
+        if mono_method == "advanced" and self.mono is not None:
+            from .utils.log import Log
+            Log.warning(
+                "monotone_constraints_method=advanced is not implemented; "
+                "falling back to 'intermediate' (more conservative — "
+                "constraints still fully enforced)")
         self.mono_method = mono_method
         self.mono_penalty = float(mono_penalty)
         self.interaction_allow = interaction_allow
         self.bynode_frac = bynode_frac
         self._bynode_rng = np.random.RandomState(bynode_seed)
+        # feature_contri (per-feature gain scale, feature_histogram.hpp) —
+        # composed multiplicatively with the monotone penalty below
+        self.feature_contri = None if feature_contri is None else \
+            jnp.asarray(feature_contri, jnp.float32)
+        self.extra_trees = bool(extra_trees)
+        self._extra_rng = np.random.RandomState(extra_seed)
         self._find = jax.jit(functools.partial(find_best_split, params=params))
         # HistogramPool analog (feature_histogram.hpp:1095,
         # histogram_pool_size): cap the number of device-resident per-leaf
@@ -266,6 +286,19 @@ class PartitionedGrower:
             if cegb_state is not None and cegb_state.active:
                 kw["gain_penalty"] = jnp.asarray(
                     cegb_state.penalty_vector(total[2]))
+            if self.feature_contri is not None:
+                gs = kw.get("gain_scale")
+                kw["gain_scale"] = self.feature_contri if gs is None \
+                    else gs * self.feature_contri
+            if self.extra_trees:
+                # one random threshold bin per feature per candidate-leaf
+                # evaluation (extremely randomized trees; host RNG since
+                # this learner is host-orchestrated anyway)
+                nb_host = np.asarray(num_bin)
+                u = self._extra_rng.rand(len(nb_host))
+                kw["rand_bin"] = jnp.asarray(
+                    np.minimum((u * np.maximum(nb_host - 1, 1)).astype(np.int32),
+                               nb_host - 2), jnp.int32)
             if self.efb is not None:
                 hist = self._expand(hist, jnp.asarray(total, jnp.float32))
             return self._find(hist, jnp.asarray(total, jnp.float32),

@@ -111,6 +111,16 @@ class GBDTModel:
         inter = self._interaction_allow(config, ds)
         self._cegb_state = self._make_cegb(config, ds)
         self._forced_spec = self._load_forced(config, ds)
+        # feature_contri: per-feature split-gain scale over used slots
+        # (feature_histogram.hpp; config.h feature_contri)
+        contri = None
+        if config.feature_contri:
+            fc = np.ones(ds.num_total_features, np.float32)
+            vals_in = np.asarray(config.feature_contri, np.float32)
+            fc[:len(vals_in)] = vals_in
+            contri = fc[np.asarray(ds.used_features)]
+        self._feature_contri = contri
+        self._extra_trees = bool(config.extra_trees)
         has_node_controls = (mono is not None and np.any(mono)) \
             or inter is not None or config.feature_fraction_bynode < 1.0 \
             or self._cegb_state is not None or self._forced_spec is not None
@@ -152,6 +162,10 @@ class GBDTModel:
                     "and feature_fraction_bynode are not supported with "
                     f"tree_learner={dist} (they require the single-chip "
                     "partitioned learner)")
+            elif contri is not None or self._extra_trees:
+                raise ValueError(
+                    "feature_contri and extra_trees are not yet supported "
+                    f"with tree_learner={dist}")
             else:
                 learner = "masked"
         else:
@@ -160,12 +174,15 @@ class GBDTModel:
         self._learner_kind = learner
 
         # device-resident binned matrix + per-feature bin metadata.
-        # EFB (efb.py): the grouped layout is used by BOTH single-chip
-        # learners (dataset.cpp:239 always-on stance); the distributed
-        # shard_map paths take the flat per-feature layout.
+        # EFB (efb.py): the grouped layout is used by the single-chip
+        # learners AND the data-parallel learner, where it shrinks the
+        # histogram psum payload (dataset.cpp:239 bundles before the
+        # reduce-scatter, data_parallel_tree_learner.cpp:174-186).
+        # Feature-parallel shards the feature axis (bundles would straddle
+        # shards) and voting votes per feature, so both keep flat layout.
         self._use_efb = (ds.efb is not None and hist_reduce is None
                          and learner in ("partitioned", "masked")
-                         and dist is None)
+                         and dist in (None, "data"))
         feat_binned = ds.binned if self._use_efb else ds.feature_binned()
         num_bin = np.asarray([ds.bin_mappers[f].num_bin for f in ds.used_features],
                              np.int32)
@@ -228,7 +245,8 @@ class GBDTModel:
             self.grower = make_dp_grower(
                 self._mesh, num_leaves=config.num_leaves,
                 num_bins=self.max_bin, params=self.split_params,
-                max_depth=config.max_depth, block_rows=config.rows_per_block)
+                max_depth=config.max_depth, block_rows=config.rows_per_block,
+                efb=self.efb_dev if self._use_efb else None)
         elif dist == "voting":
             from ..parallel.voting_parallel import make_voting_grower
             self.grower = make_voting_grower(
@@ -257,7 +275,10 @@ class GBDTModel:
                 bynode_frac=config.feature_fraction_bynode,
                 bynode_seed=config.feature_fraction_seed + 1,
                 efb=self.efb_dev,
-                pool_entries=self._pool_entries(config, ds))
+                pool_entries=self._pool_entries(config, ds),
+                feature_contri=contri,
+                extra_trees=self._extra_trees,
+                extra_seed=config.extra_seed)
         else:
             if has_node_controls:
                 raise ValueError(
@@ -269,7 +290,9 @@ class GBDTModel:
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
                 block_rows=config.rows_per_block, hist_reduce=hist_reduce,
-                efb=self.efb_dev if self._use_efb else None)
+                efb=self.efb_dev if self._use_efb else None,
+                gain_scale=contri, extra_trees=self._extra_trees,
+                extra_seed=config.extra_seed)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
             raise ValueError("linear_tree requires boosting=gbdt")
@@ -652,7 +675,10 @@ class GBDTModel:
                 num_leaves=cfg.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=cfg.max_depth,
                 block_rows=cfg.rows_per_block,
-                efb=self.efb_dev if self._use_efb else None, jit=False)
+                efb=self.efb_dev if self._use_efb else None,
+                gain_scale=self._feature_contri,
+                extra_trees=self._extra_trees, extra_seed=cfg.extra_seed,
+                jit=False)
             obj = self.objective
             lr = jnp.float32(self.learning_rate)
             use_goss = self._goss
@@ -666,6 +692,8 @@ class GBDTModel:
                     else jnp.ones_like(g)
                 vals = jnp.stack([g * w, h * w, w], axis=1)
                 kw = {"is_cat": ic} if ic is not None else {}
+                if self._extra_trees:
+                    kw["rng_iter"] = it
                 arrays = grow(self.binned_dev, vals, fmask,
                               self._nb_grow, self._na_grow, **kw)
                 lv = arrays.leaf_value * lr
@@ -817,6 +845,10 @@ class GBDTModel:
                     gkw["forced"] = self._forced_spec
                 if self._cegb_state is not None:
                     gkw["cegb_state"] = self._cegb_state
+            elif self._extra_trees and self._dist is None:
+                # per-iteration extra_trees key component (the partitioned
+                # learner's host RNG advances statefully instead)
+                gkw["rng_iter"] = jnp.int32(self.iter_)
             vals_g = self._prep_vals(vals)
             fmask_g = self._prep_fmask(fmask)
             if self._dist == "feature":

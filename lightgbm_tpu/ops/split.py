@@ -100,8 +100,13 @@ def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=None):
 
 
 def _numerical_candidates(hist, total, num_bin, na_bin, feature_mask,
-                          params: SplitParams, parent_out):
-    """Gain tensor [2, F, B] over (missing-direction, feature, threshold)."""
+                          params: SplitParams, parent_out, rand_bin=None):
+    """Gain tensor [2, F, B] over (missing-direction, feature, threshold).
+
+    rand_bin: [F] int32 or None — extra_trees mode (extremely randomized
+    trees, feature_histogram.hpp:116): each feature is only allowed to
+    split at its one pre-drawn random threshold bin.
+    """
     f, b, _ = hist.shape
     cum = jnp.cumsum(hist, axis=1)                      # [F, B, 3] inclusive
     bins = jnp.arange(b, dtype=jnp.int32)
@@ -141,6 +146,8 @@ def _numerical_candidates(hist, total, num_bin, na_bin, feature_mask,
     # dir-1 scan only exists for features with a NaN bin
     valid &= jnp.stack([jnp.ones((f, b), bool),
                         jnp.broadcast_to(has_na[:, None], (f, b))], axis=0)
+    if rand_bin is not None:
+        valid &= (bins[None, None, :] == rand_bin[None, :, None])
 
     gains = jnp.where(valid, split_gain, kMinScore)     # [2, F, B]
     return gains, lefts
@@ -246,7 +253,8 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
                     is_cat: jax.Array = None, mono: jax.Array = None,
                     out_lo: jax.Array = None, out_hi: jax.Array = None,
                     gain_penalty: jax.Array = None,
-                    gain_scale: jax.Array = None) -> SplitResult:
+                    gain_scale: jax.Array = None,
+                    rand_bin: jax.Array = None) -> SplitResult:
     """Best split for one leaf across numerical and categorical features.
 
     hist:         [F, B, 3] f32 — per-feature histograms (g, h, count)
@@ -264,14 +272,15 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
 
     num_mask = feature_mask if is_cat is None else (feature_mask & (~is_cat))
     ngains, nlefts = _numerical_candidates(hist, total, num_bin, na_bin,
-                                           num_mask, params, parent_out)
+                                           num_mask, params, parent_out,
+                                           rand_bin)
     if mono is not None:
         ngains = _monotone_adjust(ngains, nlefts, total, mono, out_lo, out_hi,
                                   0, params, parent_out)
     if gain_scale is not None:
-        # monotone_penalty: depth-scaled multiplicative penalty on splits of
-        # monotone features (ComputeMonotoneSplitGainPenalty,
-        # monotone_constraints.hpp:355; applied serial_tree_learner.cpp:779)
+        # per-feature multiplicative gain scale: monotone_penalty
+        # (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355)
+        # and/or feature_contri (feature_histogram.hpp gain *= contri)
         ngains = jnp.where(ngains > kMinScore,
                            ngains * gain_scale[None, :, None], ngains)
     if gain_penalty is not None:
@@ -289,6 +298,9 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
         cat_mask = feature_mask & is_cat
         cgains, clefts, corders = _categorical_candidates(
             hist, total, num_bin, cat_mask, params, parent_out)
+        if gain_scale is not None:
+            cgains = jnp.where(cgains > kMinScore,
+                               cgains * gain_scale[None, :, None], cgains)
         if gain_penalty is not None:
             cpen = gain_penalty[None, :, None]
             cgains = jnp.where(cgains > kMinScore,

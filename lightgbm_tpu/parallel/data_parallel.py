@@ -46,20 +46,24 @@ def shard_rows(mesh: Mesh, arr, axis: str = "data"):
 
 def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    params: SplitParams, max_depth: int = -1,
-                   block_rows: int = 0, axis: str = "data"):
+                   block_rows: int = 0, axis: str = "data", efb=None):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
-    Inputs: binned [N, F] and vals [N, 3] sharded on rows; feature metadata
-    replicated.  Output tree arrays are replicated; ``leaf_of_row`` stays
-    row-sharded.  Child histograms use the masked full pass (gather tiers
-    measured slower on TPU — PROFILE.md §2), which also keeps every shard's
-    collective schedule trivially congruent.
+    Inputs: binned [N, F] (or the bundled [N, G] group matrix when ``efb``
+    is set) and vals [N, 3] sharded on rows; feature metadata replicated.
+    Output tree arrays are replicated; ``leaf_of_row`` stays row-sharded.
+    Child histograms use the masked full pass (gather tiers measured slower
+    on TPU — PROFILE.md §2), which also keeps every shard's collective
+    schedule trivially congruent.  With ``efb`` the psum payload shrinks to
+    the bundled group-space histograms — exactly where the reference
+    bundles before reduce-scatter (dataset.cpp:239;
+    data_parallel_tree_learner.cpp:174-186).
     """
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
         hist_reduce=lambda h: lax.psum(h, axis),
-        sum_reduce=lambda t: lax.psum(t, axis), jit=False)
+        sum_reduce=lambda t: lax.psum(t, axis), efb=efb, jit=False)
 
     out_specs = TreeArrays(
         num_leaves=P(), split_feature=P(), threshold_bin=P(),

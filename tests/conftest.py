@@ -24,6 +24,33 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Skip budget (VERDICT r2: a regressing guard skipped instead of failing
+# and nobody noticed).  On the standard harness — virtual 8-device CPU
+# mesh, full toolchain — only the graphviz-executable plotting skip is
+# expected.  Every new skip must either be fixed or the budget consciously
+# raised here with a comment.
+SKIP_BUDGET = 1
+_skips: list = []
+
+
+def pytest_runtest_logreport(report):
+    if report.skipped:
+        _skips.append(f"{report.nodeid}: {report.longrepr[2] if isinstance(report.longrepr, tuple) else report.longrepr}")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # only enforce on the standard full-suite harness (virtual CPU mesh);
+    # single-chip TPU runs legitimately skip the 8-device tests
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        return
+    if session.config.args and any("::" in a for a in session.config.args):
+        return                       # targeted runs, not the full suite
+    if len(_skips) > SKIP_BUDGET and exitstatus == 0:
+        lines = "\n  ".join(_skips)
+        print(f"\nERROR: {len(_skips)} skipped tests exceed the skip "
+              f"budget ({SKIP_BUDGET}):\n  {lines}", flush=True)
+        session.exitstatus = 1
+
 
 @pytest.fixture(scope="session")
 def rng():

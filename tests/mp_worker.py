@@ -1,0 +1,101 @@
+"""Worker for the REAL multi-process distributed e2e test
+(tests/test_multiprocess.py — the reference proves its network layer with N
+localhost-socket processes, tests/distributed/_test_distributed.py:79-100;
+this is the jax.distributed analog with genuine cross-process gloo
+collectives).
+
+Each process: launch.init over localhost -> deterministic global data ->
+launch.row_shard -> distributed bin mappers (sharded FindBin + allgather)
+-> data-parallel tree growth over the 2-process mesh -> rank 0 dumps the
+tree for comparison with a single-process run.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    out = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.parallel import launch
+
+    # the REAL init path: explicit coordinator, real processes
+    launch.init(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=nproc, process_id=rank)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == nproc
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel import make_mesh
+    from lightgbm_tpu.parallel.data_parallel import make_dp_grower
+    from lightgbm_tpu.ops.split import SplitParams
+
+    rng = np.random.RandomState(0)
+    n, f = 4096, 10
+    x = rng.randn(n, f).astype(np.float64)
+    y = (x[:, 0] - 0.7 * x[:, 1] > 0).astype(np.float32)
+
+    shard = launch.row_shard(x, y)
+    assert shard.process_count == nproc
+    assert len(shard.x) == n // nproc
+
+    # distributed binning: sharded FindBin + mapper allgather over the
+    # real process group (dataset_loader.cpp:1009 analog)
+    cfg = Config({"max_bin": 31})
+    mappers = launch.global_bin_mappers(shard.sample(2048), cfg)
+    assert len(mappers) == f
+
+    local_binned = np.column_stack(
+        [mappers[j].value_to_bin(shard.x[:, j]) for j in range(f)]
+    ).astype(np.uint8)
+    g_local = (1.0 / (1.0 + np.exp(-0.0)) - shard.y).astype(np.float32)
+    h_local = np.full(len(shard.x), 0.25, np.float32)
+    vals_local = np.stack([g_local, h_local, np.ones_like(g_local)], axis=1)
+
+    mesh = make_mesh((nproc,), ("data",))
+    sh = NamedSharding(mesh, P("data", None))
+    binned = jax.make_array_from_process_local_data(sh, local_binned)
+    vals = jax.make_array_from_process_local_data(sh, vals_local)
+
+    B = max(m.num_bin for m in mappers)
+    grow = make_dp_grower(mesh, num_leaves=15, num_bins=B,
+                          params=SplitParams(min_data_in_leaf=5))
+    num_bin = jnp.asarray([m.num_bin for m in mappers], jnp.int32)
+    na_bin = jnp.asarray([m.na_bin for m in mappers], jnp.int32)
+    arrays = grow(binned, vals, jnp.ones(f, bool), num_bin, na_bin)
+
+    rec = {
+        "num_leaves": int(arrays.num_leaves),
+        "split_feature": np.asarray(arrays.split_feature).tolist(),
+        "threshold_bin": np.asarray(arrays.threshold_bin).tolist(),
+        "leaf_value": np.asarray(arrays.leaf_value).round(6).tolist(),
+        # full mapper state so the single-process reference run bins with
+        # EXACTLY the distributed-fitted mappers (distributed FindBin uses
+        # per-process samples by design, dataset_loader.cpp:1009)
+        "mappers": [{"bounds": [float(v) for v in m.bin_upper_bound],
+                     "num_bin": int(m.num_bin), "na_bin": int(m.na_bin)}
+                    for m in mappers],
+    }
+    if rank == 0:
+        with open(out, "w") as fh:
+            json.dump(rec, fh)
+    print(f"rank {rank}: tree with {rec['num_leaves']} leaves", flush=True)
+
+
+if __name__ == "__main__":
+    main()

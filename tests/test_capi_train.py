@@ -14,9 +14,31 @@ import pytest
 import lightgbm_tpu as lgb
 
 SO = os.path.join(os.path.dirname(lgb.__file__), "native", "libcapi_train.so")
+SRC = os.path.join(os.path.dirname(lgb.__file__), "native", "capi_train.cpp")
 
-pytestmark = pytest.mark.skipif(not os.path.exists(SO),
-                                reason="libcapi_train.so not built")
+
+def _ensure_built() -> str:
+    """Build libcapi_train.so on demand (VERDICT r2: a stale-path skipif
+    meant these tests silently guarded nothing; now only a FAILING build
+    skips, with the compiler error in the reason)."""
+    if os.path.exists(SO) and os.path.getmtime(SO) >= os.path.getmtime(SRC):
+        return ""
+    inc = subprocess.run(["python3-config", "--includes"],
+                         capture_output=True, text=True)
+    ld = subprocess.run(["python3-config", "--ldflags", "--embed"],
+                        capture_output=True, text=True)
+    if inc.returncode != 0 or ld.returncode != 0:
+        return "python3-config unavailable"
+    cmd = (["g++", "-O2", "-shared", "-fPIC", SRC, "-o", SO]
+           + inc.stdout.split() + ld.stdout.split())
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        return f"build failed: {r.stderr[-400:]}"
+    return ""
+
+
+_BUILD_ERR = _ensure_built()
+pytestmark = pytest.mark.skipif(bool(_BUILD_ERR), reason=_BUILD_ERR)
 
 
 def _data(n=1200, f=6, seed=0):

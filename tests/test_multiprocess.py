@@ -1,0 +1,96 @@
+"""Multi-process distributed e2e (VERDICT r2 task 9a): 2 REAL processes
+over localhost exercise ``launch.init``'s actual jax.distributed path,
+distributed binning, and data-parallel tree growth with genuine
+cross-process gloo collectives — then the grown tree must equal a
+single-process run (the contract the reference tests with socket
+subprocesses, tests/distributed/_test_distributed.py:79-100)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "mp_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_data_parallel_matches_single(tmp_path):
+    port = _free_port()
+    out = tmp_path / "mp_tree.json"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(rank), "2", str(port), str(out)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for rank in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(o)
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
+    assert out.exists(), outs[0][-2000:]
+    mp = json.loads(out.read_text())
+
+    # single-process reference: same data, same binning config
+    from lightgbm_tpu.binning import BinMapper
+    from lightgbm_tpu.grower import make_grower
+    from lightgbm_tpu.ops.split import SplitParams
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n, f = 4096, 10
+    x = rng.randn(n, f).astype(np.float64)
+    y = (x[:, 0] - 0.7 * x[:, 1] > 0).astype(np.float32)
+
+    # bin with EXACTLY the workers' distributed-fitted mappers (dumped in
+    # the record): distributed FindBin samples per process by design, so a
+    # full-data refit here would legitimately differ
+    from lightgbm_tpu.binning import BinType, MissingType
+    mappers = []
+    for spec in mp["mappers"]:
+        m = BinMapper()
+        m.bin_upper_bound = np.asarray(spec["bounds"], np.float64)
+        m.num_bin = spec["num_bin"]
+        m.bin_type = BinType.NUMERICAL
+        m.missing_type = MissingType.NONE   # na_bin derives from this
+        assert m.na_bin == spec["na_bin"]
+        mappers.append(m)
+    binned = np.column_stack(
+        [mappers[j].value_to_bin(x[:, j]) for j in range(f)]
+    ).astype(np.uint8)
+    g = (0.5 - y).astype(np.float32)
+    h = np.full(n, 0.25, np.float32)
+    vals = jnp.asarray(np.stack([g, h, np.ones_like(g)], axis=1))
+
+    B = max(m.num_bin for m in mappers)
+    grow = make_grower(num_leaves=15, num_bins=B,
+                       params=SplitParams(min_data_in_leaf=5))
+    arrays = grow(jnp.asarray(binned), vals, jnp.ones(f, bool),
+                  jnp.asarray([m.num_bin for m in mappers], jnp.int32),
+                  jnp.asarray([m.na_bin for m in mappers], jnp.int32))
+
+    assert mp["num_leaves"] == int(arrays.num_leaves)
+    np.testing.assert_array_equal(mp["split_feature"],
+                                  np.asarray(arrays.split_feature))
+    np.testing.assert_array_equal(mp["threshold_bin"],
+                                  np.asarray(arrays.threshold_bin))
+    np.testing.assert_allclose(mp["leaf_value"],
+                               np.asarray(arrays.leaf_value),
+                               rtol=2e-4, atol=2e-5)

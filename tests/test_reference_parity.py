@@ -1,0 +1,177 @@
+"""Reference-parity tier (VERDICT r2 task 4).
+
+Two claims are tested against the ACTUAL reference implementation
+(/root/reference, LightGBM v3.3.x fork), the way its own
+tests/python_package_test/test_consistency.py does:
+
+1. **Model-format compatibility**: models trained by the reference CLI
+   (committed fixtures, see tests/fixtures/reference/README.md) load in
+   this framework and predict the reference's own `*.test` files to within
+   float tolerance of the reference's own predictions; re-serializing with
+   our writer round-trips exactly.
+2. **Training quality on the reference's example datasets + conf files**:
+   training with each example's train.conf parameters reaches golden
+   metric thresholds derived from the reference's 20-iteration results.
+"""
+
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+EXAMPLES = Path("/root/reference/examples")
+FIXTURES = Path(__file__).parent / "fixtures" / "reference"
+
+pytestmark = pytest.mark.skipif(
+    not EXAMPLES.exists(), reason="reference examples not available")
+
+
+def load_conf(path: Path) -> dict:
+    """Parse a reference train.conf (test_consistency.py FileLoader)."""
+    params = {}
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#") and "=" in line:
+            k, v = (t.strip() for t in line.split("=", 1))
+            params[k] = v
+    return params
+
+
+def load_svm(path: Path, n_features=None):
+    """label + dense matrix; auto-detects the dense TSV files (binary,
+    regression, multiclass) vs the sparse LibSVM ranking files."""
+    with open(path) as f:
+        first = f.readline()
+    if ":" not in first:
+        mat = np.loadtxt(str(path), dtype=np.float64)
+        return mat[:, 1:], mat[:, 0]
+    from sklearn.datasets import load_svmlight_file
+    x, y = load_svmlight_file(str(path), dtype=np.float64, zero_based=True,
+                              n_features=n_features)
+    return np.asarray(x.todense()), y
+
+
+def _train_params(conf: dict, extra=None) -> dict:
+    drop = {"task", "data", "valid_data", "output_model", "input_model",
+            "output_result", "machine_list_file", "num_machines",
+            "local_listen_port", "tree_learner", "is_training_metric",
+            "label_column", "query_column", "metric_freq",
+            "is_enable_sparse", "use_two_round_loading",
+            "is_save_binary_file"}
+    p = {k: v for k, v in conf.items() if k not in drop}
+    p["verbosity"] = -1
+    p["num_trees"] = 20
+    if extra:
+        p.update(extra)
+    return p
+
+
+CASES = {
+    # task: (example dir, prefix, fixture stem)
+    "binary": ("binary_classification", "binary", "binary"),
+    "regression": ("regression", "regression", "regression"),
+    "multiclass": ("multiclass_classification", "multiclass", "multiclass"),
+    "lambdarank": ("lambdarank", "rank", "lambdarank"),
+    "xendcg": ("xendcg", "rank", "xendcg"),
+}
+
+
+@pytest.mark.parametrize("task", sorted(CASES))
+def test_load_reference_model_predict_parity(task):
+    """A reference-trained model.txt must load and reproduce the
+    reference's own predictions on its own test file."""
+    ex_dir, prefix, stem = CASES[task]
+    model_txt = (FIXTURES / f"{stem}_model.txt").read_text()
+    n_feat = next((int(l.split("=")[1]) + 1
+                   for l in model_txt.splitlines()
+                   if l.startswith("max_feature_idx=")), None)
+    x_test, _ = load_svm(EXAMPLES / ex_dir / f"{prefix}.test",
+                         n_features=n_feat)
+    bst = lgb.Booster(model_file=str(FIXTURES / f"{stem}_model.txt"))
+    pred = np.asarray(bst.predict(x_test))
+    ref = np.loadtxt(str(FIXTURES / f"{stem}_pred.txt"))
+    assert pred.shape == ref.shape
+    np.testing.assert_allclose(pred, ref, rtol=1e-5, atol=1e-7)
+
+    # round-trip through OUR writer must preserve predictions exactly
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(np.asarray(bst2.predict(x_test)), pred,
+                               rtol=1e-9, atol=0)
+
+
+# golden thresholds: reference 20-iter valid metrics with slack for
+# binning/bagging RNG differences (fixtures README records the exact values)
+def _ndcg5(bst, x, y, qs):
+    from lightgbm_tpu.metrics import NDCGMetric
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import Metadata
+
+    raw = np.asarray(bst.predict(x, raw_score=True))
+    md = Metadata(len(y))
+    md.label = np.asarray(y)
+    md.set_group(qs)
+    m = NDCGMetric(Config({"eval_at": [5]}))
+    m.init(md, len(y))
+    return m.eval(raw)[0][1]
+
+
+def test_train_binary_reference_conf():
+    conf = load_conf(EXAMPLES / "binary_classification" / "train.conf")
+    x, y = load_svm(EXAMPLES / "binary_classification" / "binary.train")
+    w = np.loadtxt(str(EXAMPLES / "binary_classification"
+                       / "binary.train.weight"))
+    xt, yt = load_svm(EXAMPLES / "binary_classification" / "binary.test")
+    params = _train_params(conf)
+    bst = lgb.train(params, lgb.Dataset(x, label=y, weight=w, params=params),
+                    num_boost_round=20)
+    from lightgbm_tpu.metrics import _auc
+    auc = _auc(yt, np.asarray(bst.predict(xt, raw_score=True)), None)
+    assert auc > 0.78, f"valid AUC {auc} vs reference 0.8014"
+
+
+def test_train_regression_reference_conf():
+    conf = load_conf(EXAMPLES / "regression" / "train.conf")
+    x, y = load_svm(EXAMPLES / "regression" / "regression.train")
+    xt, yt = load_svm(EXAMPLES / "regression" / "regression.test")
+    params = _train_params(conf)
+    bst = lgb.train(params, lgb.Dataset(x, label=y, params=params),
+                    num_boost_round=20)
+    l2 = float(np.mean((np.asarray(bst.predict(xt)) - yt) ** 2))
+    assert l2 < 0.24, f"valid l2 {l2} vs reference 0.1989"
+
+
+def test_train_multiclass_reference_conf():
+    conf = load_conf(EXAMPLES / "multiclass_classification" / "train.conf")
+    x, y = load_svm(EXAMPLES / "multiclass_classification"
+                    / "multiclass.train")
+    xt, yt = load_svm(EXAMPLES / "multiclass_classification"
+                      / "multiclass.test")
+    params = _train_params(conf)
+    bst = lgb.train(params, lgb.Dataset(x, label=y, params=params),
+                    num_boost_round=20)
+    p = np.clip(np.asarray(bst.predict(xt)), 1e-15, 1.0)
+    ll = float(np.mean(-np.log(p[np.arange(len(yt)), yt.astype(int)])))
+    assert ll < 1.65, f"valid multi_logloss {ll} vs reference 1.4663"
+
+
+@pytest.mark.parametrize("task,floor", [("lambdarank", 0.55),
+                                        ("xendcg", 0.55)])
+def test_train_ranking_reference_conf(task, floor):
+    ex_dir, prefix, _ = CASES[task]
+    conf = load_conf(EXAMPLES / ex_dir / "train.conf")
+    x, y = load_svm(EXAMPLES / ex_dir / f"{prefix}.train")
+    qs = np.loadtxt(str(EXAMPLES / ex_dir / f"{prefix}.train.query"),
+                    dtype=np.int64)
+    xt, yt = load_svm(EXAMPLES / ex_dir / f"{prefix}.test",
+                      n_features=x.shape[1])
+    qt = np.loadtxt(str(EXAMPLES / ex_dir / f"{prefix}.test.query"),
+                    dtype=np.int64)
+    params = _train_params(conf)
+    bst = lgb.train(params,
+                    lgb.Dataset(x, label=y, group=qs, params=params),
+                    num_boost_round=20)
+    ndcg = _ndcg5(bst, xt, yt, qt)
+    assert ndcg > floor, f"{task} valid ndcg@5 {ndcg} vs reference ~0.63-0.65"

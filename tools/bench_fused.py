@@ -31,7 +31,7 @@ def main():
               "learning_rate": 0.1, "max_bin": 63, "min_data_in_leaf": 20,
               "verbosity": 0, "fused_chunk": chunk}
     t0 = time.time()
-    ds = lgb.Dataset(x, label=y)
+    ds = lgb.Dataset(x, label=y, params=params)   # bin at the CLAIMED max_bin
     ds.construct()
     print(f"bin: {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
 

@@ -73,7 +73,7 @@ def main():
     params = {"objective": "binary", "num_leaves": num_leaves,
               "learning_rate": 0.1, "max_bin": 63, "min_data_in_leaf": 20,
               "verbosity": 0}
-    ds = lgb.Dataset(x, label=y)
+    ds = lgb.Dataset(x, label=y, params=params)   # bin at the CLAIMED max_bin
     ds.construct()
     bst = lgb.Booster(params=params, train_set=ds)
     m = bst._model
