@@ -61,8 +61,16 @@ def _forced_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
         sub = _greedy_find_bin(vals, cnts, b, int(cnts.sum()),
                                min_data_in_bin)
         bounds.extend(x for x in sub if np.isfinite(x))
-    out = sorted(set(bounds))[:max_bin - 1]
-    return out + [np.inf]
+    uniq = sorted(set(bounds))
+    if len(uniq) > max_bin - 1:
+        # per-segment minimum budgets (max(1, ...)) can overshoot; drop
+        # GREEDY bounds only — forced boundaries are mandatory (they were
+        # already capped to max_bin-1 above, so they always fit)
+        fset = set(forced)
+        greedy_keep = (max_bin - 1) - len(fset)
+        uniq = sorted(fset | set(
+            [x for x in uniq if x not in fset][:max(greedy_keep, 0)]))
+    return uniq + [np.inf]
 
 
 def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,

@@ -173,6 +173,46 @@ class Booster:
     def num_model_per_iteration(self) -> int:
         return self._num_tree_per_iteration
 
+    def num_feature(self) -> int:
+        """Number of features the model was trained on (basic.py
+        Booster.num_feature / LGBM_BoosterGetNumFeature)."""
+        return self._max_feature_idx + 1
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Re-set training parameters for FUTURE iterations
+        (LGBM_BoosterResetParameter, src/c_api.cpp ResetConfig; Python
+        basic.py reset_parameter).  Structural parameters that would
+        require re-binning or a new grower (num_leaves, max_bin,
+        tree_learner, ...) are rejected like the reference's
+        ResetConfig does for dataset-coupled params."""
+        if self._model is None:
+            raise ValueError("reset_parameter needs an active training "
+                             "Booster (not a loaded model)")
+        # bagging_* is excluded: Config zeroes bagging_freq at construction
+        # when all fractions are 1.0, so enabling bagging mid-training
+        # would silently no-op — reject it instead of pretending
+        allowed_now = {"learning_rate", "verbosity", "verbose",
+                       "metric_freq", "feature_fraction",
+                       "feature_fraction_seed", "first_metric_only"}
+        from .config import _ALIASES, _coerce, _PARAMS
+        for k, v in params.items():
+            canon = _ALIASES.get(k, k)
+            if canon not in allowed_now:
+                raise ValueError(
+                    f"cannot reset parameter {k!r} on a live Booster "
+                    "(requires dataset/grower reconstruction)")
+            setattr(self._model.config, canon,
+                    _coerce(canon, _PARAMS[canon][0], v))
+        if "learning_rate" in params or "eta" in params \
+                or "shrinkage_rate" in params:
+            self._model.learning_rate = float(
+                self._model.config.learning_rate)
+        # the fused-chunk program bakes the learning rate (and sampling
+        # config) into its jitted closure — drop it so the next chunk
+        # re-traces with the new values
+        self._model._fused_cache.clear()
+        return self
+
     # ------------------------------------------------------------------
     def eval_train(self, feval=None) -> List[Tuple]:
         score = self._model.train_score()

@@ -55,6 +55,18 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
             np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+        lib.LGBM_BoosterPredictForCSR.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+        lib.LGBM_BoosterPredictForFile.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
         _lib = lib
     return _lib
 
@@ -112,10 +124,17 @@ class NativeBooster:
     def predict(self, data, raw_score: bool = False, pred_leaf: bool = False,
                 start_iteration: int = 0,
                 num_iteration: int = -1) -> np.ndarray:
-        x = np.ascontiguousarray(np.asarray(data, np.float64))
-        if x.ndim == 1:
-            x = x.reshape(1, -1)
-        nrow, ncol = x.shape
+        """Dense ndarray or scipy CSR/CSC input (the sparse path stays in
+        C via LGBM_BoosterPredictForCSR — c_api.h:815 parity)."""
+        sparse = hasattr(data, "tocsr") and not isinstance(data, np.ndarray)
+        if sparse:
+            m = data.tocsr()
+            nrow, ncol = m.shape
+        else:
+            x = np.ascontiguousarray(np.asarray(data, np.float64))
+            if x.ndim == 1:
+                x = x.reshape(1, -1)
+            nrow, ncol = x.shape
         k = self.num_classes
         if pred_leaf:
             ptype = _PRED_LEAF
@@ -128,9 +147,18 @@ class NativeBooster:
             width = k
         out = np.zeros((nrow, width), np.float64)
         out_len = ctypes.c_int64()
-        rc = self._lib.LGBM_BoosterPredictForMat(
-            self._handle, x, nrow, ncol, ptype, start_iteration,
-            num_iteration, ctypes.byref(out_len), out)
+        if sparse:
+            indptr = np.ascontiguousarray(m.indptr, np.int32)
+            indices = np.ascontiguousarray(m.indices, np.int32)
+            vals = np.ascontiguousarray(m.data, np.float64)
+            rc = self._lib.LGBM_BoosterPredictForCSR(
+                self._handle, indptr, len(indptr), indices, vals,
+                len(vals), ncol, ptype, start_iteration, num_iteration,
+                ctypes.byref(out_len), out)
+        else:
+            rc = self._lib.LGBM_BoosterPredictForMat(
+                self._handle, x, nrow, ncol, ptype, start_iteration,
+                num_iteration, ctypes.byref(out_len), out)
         if rc != 0:
             raise RuntimeError(self._lib.LGBM_GetLastError().decode())
         width_actual = out_len.value // nrow if nrow else width
@@ -138,6 +166,20 @@ class NativeBooster:
         if pred_leaf:
             return out.astype(np.int32)
         return out if k > 1 else out[:, 0]
+
+    def predict_file(self, data_filename: str, result_filename: str,
+                     has_header: bool = False, raw_score: bool = False,
+                     pred_leaf: bool = False, start_iteration: int = 0,
+                     num_iteration: int = -1) -> None:
+        """CSV/TSV/LibSVM file -> predictions file, entirely in C
+        (LGBM_BoosterPredictForFile, c_api.h:749; Predictor task=predict)."""
+        ptype = _PRED_LEAF if pred_leaf else (
+            _PRED_RAW if raw_score else _PRED_NORMAL)
+        rc = self._lib.LGBM_BoosterPredictForFile(
+            self._handle, data_filename.encode(), int(has_header), ptype,
+            start_iteration, num_iteration, result_filename.encode())
+        if rc != 0:
+            raise RuntimeError(self._lib.LGBM_GetLastError().decode())
 
     def _trees_per_iter(self) -> int:
         return self.num_classes if self.num_classes > 1 else 1

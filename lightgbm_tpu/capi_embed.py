@@ -53,8 +53,14 @@ def dataset_create_from_file(path: str, params: str,
     return Dataset(x, label=y, params=p, reference=reference)
 
 
-def dataset_set_field(ds: Dataset, name: str, mv, n: int, dtype: int) -> None:
+def dataset_set_field(ds, name: str, mv, n: int, dtype: int) -> None:
     arr = np.frombuffer(mv, _NP_OF[int(dtype)])[:int(n)].copy()
+    if isinstance(ds, _StreamingDataset) and ds.ds is None:
+        # SetField is valid at any point of the streaming protocol in the
+        # reference C API; it must not finalize the dataset mid-stream
+        ds.pending_fields[name] = arr
+        return
+    ds = _as_dataset(ds)
     if name == "label":
         ds.set_label(arr)
     elif name == "weight":
@@ -67,26 +73,28 @@ def dataset_set_field(ds: Dataset, name: str, mv, n: int, dtype: int) -> None:
         raise ValueError(f"unknown field {name!r}")
 
 
-def dataset_num_data(ds: Dataset) -> int:
+def dataset_num_data(ds) -> int:
+    ds = _as_dataset(ds)
     ds.construct()
     return int(ds.num_data)
 
 
-def dataset_num_feature(ds: Dataset) -> int:
+def dataset_num_feature(ds) -> int:
+    ds = _as_dataset(ds)
     ds.construct()
     return int(ds.num_total_features)
 
 
-def booster_create(ds: Dataset, params: str) -> Booster:
-    return Booster(params=_params(params), train_set=ds)
+def booster_create(ds, params: str) -> Booster:
+    return Booster(params=_params(params), train_set=_as_dataset(ds))
 
 
 def booster_create_from_model_string(s: str) -> Booster:
     return Booster(model_str=s)
 
 
-def booster_add_valid(bst: Booster, ds: Dataset, name: str) -> None:
-    bst.add_valid(ds, name)
+def booster_add_valid(bst: Booster, ds, name: str) -> None:
+    bst.add_valid(_as_dataset(ds), name)
 
 
 def booster_update(bst: Booster) -> int:
@@ -125,12 +133,8 @@ def booster_get_eval(bst: Booster) -> str:
     return "\n".join(f"{dn}\t{mn}\t{val!r}" for dn, mn, val, _ in rows)
 
 
-def booster_predict_mat(bst: Booster, mv, nrow: int, ncol: int,
-                        predict_type: int, start_iteration: int,
-                        num_iteration: int, out_mv) -> int:
-    """predict_type: 0 normal, 1 raw, 2 leaf index, 3 contrib
-    (C_API_PREDICT_* values, c_api.h:527-535)."""
-    x = np.frombuffer(mv, np.float64).reshape(int(nrow), int(ncol))
+def _predict_out(bst: Booster, x, predict_type: int, start_iteration: int,
+                 num_iteration: int, out_mv) -> int:
     num = num_iteration if num_iteration > 0 else None
     kw = dict(start_iteration=int(start_iteration), num_iteration=num)
     if predict_type == 2:
@@ -147,3 +151,202 @@ def booster_predict_mat(bst: Booster, mv, nrow: int, ncol: int,
                          f"have {len(out)}")
     out[:len(flat)] = flat
     return int(len(flat))
+
+
+def booster_predict_mat(bst: Booster, mv, nrow: int, ncol: int,
+                        predict_type: int, start_iteration: int,
+                        num_iteration: int, out_mv) -> int:
+    """predict_type: 0 normal, 1 raw, 2 leaf index, 3 contrib
+    (C_API_PREDICT_* values, c_api.h:527-535)."""
+    x = np.frombuffer(mv, np.float64).reshape(int(nrow), int(ncol))
+    return _predict_out(bst, x, predict_type, start_iteration,
+                        num_iteration, out_mv)
+
+
+# ---------------------------------------------------------------------------
+# CSR / CSC dataset construction + prediction
+# (LGBM_DatasetCreateFromCSR/CSC c_api.h:200-268;
+#  LGBM_BoosterPredictForCSR c_api.h:815)
+# ---------------------------------------------------------------------------
+
+def _sparse_parts(indptr_mv, n_indptr, indices_mv, data_mv, nelem):
+    indptr = np.frombuffer(indptr_mv, np.int32)[:int(n_indptr)].copy()
+    indices = np.frombuffer(indices_mv, np.int32)[:int(nelem)].copy()
+    data = np.frombuffer(data_mv, np.float64)[:int(nelem)].copy()
+    return indptr, indices, data
+
+
+def _csr(indptr_mv, n_indptr, indices_mv, data_mv, nelem, ncol):
+    from scipy.sparse import csr_matrix
+    indptr, indices, data = _sparse_parts(indptr_mv, n_indptr, indices_mv,
+                                          data_mv, nelem)
+    return csr_matrix((data, indices, indptr),
+                      shape=(int(n_indptr) - 1, int(ncol)))
+
+
+def dataset_create_from_csr(indptr_mv, n_indptr, indices_mv, data_mv,
+                            nelem, ncol, params: str,
+                            reference: Optional[Dataset] = None) -> Dataset:
+    return Dataset(_csr(indptr_mv, n_indptr, indices_mv, data_mv, nelem,
+                        ncol), params=_params(params), reference=reference)
+
+
+def dataset_create_from_csc(indptr_mv, n_indptr, indices_mv, data_mv,
+                            nelem, nrow, params: str,
+                            reference: Optional[Dataset] = None) -> Dataset:
+    from scipy.sparse import csc_matrix
+    indptr, indices, data = _sparse_parts(indptr_mv, n_indptr, indices_mv,
+                                          data_mv, nelem)
+    mat = csc_matrix((data, indices, indptr),
+                     shape=(int(nrow), int(n_indptr) - 1))
+    return Dataset(mat, params=_params(params), reference=reference)
+
+
+def booster_predict_csr(bst: Booster, indptr_mv, n_indptr, indices_mv,
+                        data_mv, nelem, ncol, predict_type: int,
+                        start_iteration: int, num_iteration: int,
+                        out_mv) -> int:
+    x = _csr(indptr_mv, n_indptr, indices_mv, data_mv, nelem, ncol)
+    return _predict_out(bst, x, predict_type, start_iteration,
+                        num_iteration, out_mv)
+
+
+# ---------------------------------------------------------------------------
+# Streaming dataset construction
+# (LGBM_DatasetCreateFromSampledColumn + LGBM_DatasetPushRows[ByCSR],
+#  c_api.h:109-313).  The reference pre-builds bin mappers from the sample
+#  and bins rows as they are pushed; here rows are accumulated and binned
+#  at finalize — same API contract and final Dataset, with peak memory one
+#  float64 copy of the raw matrix (the TPU learner keeps a dense binned
+#  matrix in HBM anyway, so sampled-column binning would not change the
+#  steady-state footprint).
+# ---------------------------------------------------------------------------
+
+class _StreamingDataset:
+    def __init__(self, nrow: int, ncol: int, params: str):
+        self.buf = np.full((int(nrow), int(ncol)), np.nan, np.float64)
+        self.filled = 0
+        self.params = _params(params)
+        self.pending_fields: dict = {}
+        self.ds: Optional[Dataset] = None
+
+    def finish(self) -> Dataset:
+        if self.ds is None:
+            self.ds = Dataset(self.buf[:self.filled], params=self.params)
+            for name, arr in self.pending_fields.items():
+                dataset_set_field(self.ds, name, memoryview(arr.tobytes()),
+                                  len(arr),
+                                  {np.dtype(np.float32): _F32,
+                                   np.dtype(np.float64): _F64,
+                                   np.dtype(np.int32): _I32,
+                                   np.dtype(np.int64): _I64}[arr.dtype])
+        return self.ds
+
+
+def dataset_create_streaming(nrow: int, ncol: int,
+                             params: str) -> _StreamingDataset:
+    return _StreamingDataset(nrow, ncol, params)
+
+
+def dataset_push_rows(sd: _StreamingDataset, mv, nrow: int, ncol: int,
+                      start_row: int) -> None:
+    if sd.ds is not None:
+        raise ValueError("dataset already finalized")
+    arr = np.frombuffer(mv, np.float64).reshape(int(nrow), int(ncol))
+    sd.buf[int(start_row):int(start_row) + int(nrow), :int(ncol)] = arr
+    sd.filled = max(sd.filled, int(start_row) + int(nrow))
+
+
+def dataset_push_rows_by_csr(sd: _StreamingDataset, indptr_mv, n_indptr,
+                             indices_mv, data_mv, nelem,
+                             start_row: int) -> None:
+    if sd.ds is not None:
+        raise ValueError("dataset already finalized")
+    x = _csr(indptr_mv, n_indptr, indices_mv, data_mv, nelem,
+             sd.buf.shape[1]).toarray()
+    sd.buf[int(start_row):int(start_row) + x.shape[0]] = x
+    sd.filled = max(sd.filled, int(start_row) + x.shape[0])
+
+
+def _as_dataset(ds):
+    """Streaming handles are accepted anywhere a Dataset is (finalized on
+    first use, like the reference's mark-finished semantics)."""
+    return ds.finish() if isinstance(ds, _StreamingDataset) else ds
+
+
+# ---------------------------------------------------------------------------
+# Booster getters / reset (c_api.h booster introspection surface)
+# ---------------------------------------------------------------------------
+
+def booster_num_feature(bst: Booster) -> int:
+    return int(bst.num_feature())
+
+
+def booster_get_eval_names(bst: Booster) -> str:
+    """Metadata-only (the reference's GetEvalNames does not evaluate)."""
+    names = []
+    for m in bst._train_metrics:
+        if m.name not in names:
+            names.append(m.name)
+    return "\t".join(names)
+
+
+def booster_feature_importance(bst: Booster, importance_type: int,
+                               out_mv) -> int:
+    """importance_type: 0 split, 1 gain (C_API_FEATURE_IMPORTANCE_*)."""
+    imp = bst.feature_importance(
+        importance_type="gain" if importance_type == 1 else "split")
+    out = np.frombuffer(out_mv, np.float64)
+    if len(imp) > len(out):
+        raise ValueError("output buffer too small")
+    out[:len(imp)] = imp.astype(np.float64)
+    return int(len(imp))
+
+
+def booster_reset_parameter(bst: Booster, params: str) -> None:
+    bst.reset_parameter(_params(params))
+
+
+# ---------------------------------------------------------------------------
+# Network init (LGBM_NetworkInit, c_api.h:1350).  The reference builds its
+# socket-collective mesh from a machine list; the TPU framework's
+# collectives are XLA's, so this maps onto the jax.distributed runtime:
+# coordinator = first machine, rank = position of the entry whose port
+# matches local_listen_port (the reference derives rank by matching local
+# addresses the same way, src/network/linkers_socket.cpp).
+# ---------------------------------------------------------------------------
+
+def network_init(machines: str, local_listen_port: int, listen_time_out: int,
+                 num_machines: int) -> None:
+    if num_machines <= 1:
+        return
+    entries = [m.strip() for m in machines.replace("\n", ",").split(",")
+               if m.strip()]
+    if len(entries) != num_machines:
+        raise ValueError(
+            f"machines lists {len(entries)} entries, num_machines="
+            f"{num_machines}")
+    from .parallel import launch
+    # multi-process-per-host (the reference's distributed test topology,
+    # tests/distributed/_test_distributed.py): every entry is the same
+    # host with a DISTINCT port, so the port identifies the rank.  Only
+    # safe when exactly one entry matches — the canonical multi-host
+    # layout reuses one port on every machine, where the port would match
+    # entry 0 everywhere; that case goes to launch.init's local-address
+    # matching instead.
+    matches = [i for i, e in enumerate(entries)
+               if e.endswith(f":{local_listen_port}")]
+    if len(matches) == 1:
+        launch.init(coordinator_address=entries[0],
+                    num_processes=num_machines, process_id=matches[0])
+    else:
+        launch.init(machines=",".join(entries),
+                    local_listen_port=local_listen_port)
+
+
+def network_free() -> None:
+    import jax
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        pass  # never initialized

@@ -178,6 +178,14 @@ _PARAMS: Dict[str, tuple] = {
     # round-trip (measured ~67 ms on a tunneled chip) over the chunk.
     # 0/1 disables fusion.
     "fused_chunk": (int, 25, []),
+    # leaves split per grower super-step (masked learner).  1 = exact
+    # strict leaf-wise growth (reference semantics).  K>1 splits the top-K
+    # leaves by cached gain per step and builds all K child histograms in
+    # ONE C=3K one-hot contraction — ~K× more MXU sublane utilization and
+    # 1/K the one-hot passes (PROFILE.md), at the cost of a slightly
+    # different (still best-first) growth order.  0 = auto: 1 below 64
+    # leaves, then 8.
+    "split_batch": (int, 0, []),
     "use_pallas": (bool, True, []),          # use Pallas kernels where available
     # ---- IO / task ----
     "task": (str, "train", ["task_type"]),

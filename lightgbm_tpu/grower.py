@@ -105,6 +105,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 efb=None,
                 gain_scale=None,
                 extra_trees: bool = False, extra_seed: int = 6,
+                split_batch: int = 1,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
@@ -141,6 +142,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       totals reconstructing the shared default bin (FixHistogram,
       dataset.cpp:1292).  Row partitioning decodes the winning feature's
       bins from its group column.
+    - split_batch=K>1: grow K leaves per super-step instead of strictly
+      one.  Each step picks the top-K leaves by cached best gain, applies
+      all K splits in one row-partition pass, and builds all K smaller
+      children's histograms in ONE one-hot contraction with C=3K channels.
+      PROFILE.md §2-6: the histogram matmul is sublane-bound at M=3 (3 of
+      8 sublanes, ~4.6 TFLOP/s ceiling), so batching K leaves raises the
+      ceiling ~K× while amortizing the one-hot generation — per-split cost
+      drops toward 1/K.  Trees differ slightly from strict leaf-wise
+      growth (between LightGBM's leaf-wise and XGBoost's depth-wise);
+      K=1 keeps exact reference semantics and is the default.
     """
     L = int(num_leaves)
     B = int(num_bins)
@@ -229,16 +240,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                 is_cat, gain_scale=gscale, rand_bin=rb))
         )(hist2, totals2, parent_out2, rand2)
 
-    def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
-                  na_bin_part=None, is_cat=None,
-                  rng_iter=None) -> TreeArrays:
-        n, _f_global = binned.shape
-        binned_view = view_fn(binned)
-        f = binned_view.shape[1]
-        child_hist = _make_child_hist(n)
-        if na_bin_part is None:
-            na_bin_part = na_bin
-
+    def _root_eval(binned_view, vals, feature_mask, num_bin, na_bin,
+                   is_cat, rng_iter):
+        """Root histogram + aggregates + best split; shared by the strict
+        and batched growers."""
         hist0 = _hist(binned_view, vals)            # [F|G, B|Bg, 3]
         # root aggregates from vals directly, NOT from hist0[0]: a filtering
         # hist_reduce (voting's top-k zeroing) may have dropped feature 0's
@@ -270,42 +275,63 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                          num_bin, na_bin, feature_mask,
                                          params, root_out, is_cat,
                                          gain_scale=gscale, rand_bin=rb0))
+        return hist0, total0, root_out, res0, et_key
 
+    def _init_state(n, nleaf, nnode, fv, hist0, total0, root_out,
+                    res0) -> _GrowState:
+        """Fresh grow state with ``nleaf`` leaf slots / ``nnode`` node
+        slots (== L/L-1 strict; +K scratch slots batched)."""
         neg_inf = jnp.float32(-jnp.inf)
-        st = _GrowState(
+        return _GrowState(
             leaf_of_row=jnp.zeros(n, jnp.int32),
-            hist=jnp.zeros((L, binned_view.shape[1], Bh, 3),
+            hist=jnp.zeros((nleaf, fv, Bh, 3),
                            jnp.float32).at[0].set(hist0),
-            bg=jnp.full(L, neg_inf).at[0].set(res0.gain),
-            bf=jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
-            bt=jnp.zeros(L, jnp.int32).at[0].set(res0.threshold),
-            bdl=jnp.zeros(L, bool).at[0].set(res0.default_left),
-            bls=jnp.zeros((L, 3)).at[0].set(res0.left_sum),
-            brs=jnp.zeros((L, 3)).at[0].set(res0.right_sum),
-            blo=jnp.zeros(L).at[0].set(res0.left_output),
-            bro=jnp.zeros(L).at[0].set(res0.right_output),
-            bic=jnp.zeros(L, bool).at[0].set(res0.is_cat),
-            brank=jnp.zeros((L, B), jnp.int32).at[0].set(res0.bin_rank),
-            split_feature=jnp.zeros(L - 1, jnp.int32),
-            threshold_bin=jnp.zeros(L - 1, jnp.int32),
-            default_left=jnp.zeros(L - 1, bool),
-            left_child=jnp.zeros(L - 1, jnp.int32),
-            right_child=jnp.zeros(L - 1, jnp.int32),
-            split_gain=jnp.zeros(L - 1, jnp.float32),
-            leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
-            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(total0[1]),
-            leaf_count=jnp.zeros(L, jnp.float32).at[0].set(total0[2]),
-            internal_value=jnp.zeros(L - 1, jnp.float32),
-            internal_weight=jnp.zeros(L - 1, jnp.float32),
-            internal_count=jnp.zeros(L - 1, jnp.float32),
-            leaf_depth=jnp.zeros(L, jnp.int32),
-            leaf_parent=jnp.full(L, -1, jnp.int32),
+            bg=jnp.full(nleaf, neg_inf).at[0].set(res0.gain),
+            bf=jnp.zeros(nleaf, jnp.int32).at[0].set(res0.feature),
+            bt=jnp.zeros(nleaf, jnp.int32).at[0].set(res0.threshold),
+            bdl=jnp.zeros(nleaf, bool).at[0].set(res0.default_left),
+            bls=jnp.zeros((nleaf, 3)).at[0].set(res0.left_sum),
+            brs=jnp.zeros((nleaf, 3)).at[0].set(res0.right_sum),
+            blo=jnp.zeros(nleaf).at[0].set(res0.left_output),
+            bro=jnp.zeros(nleaf).at[0].set(res0.right_output),
+            bic=jnp.zeros(nleaf, bool).at[0].set(res0.is_cat),
+            brank=jnp.zeros((nleaf, B), jnp.int32).at[0].set(res0.bin_rank),
+            split_feature=jnp.zeros(nnode, jnp.int32),
+            threshold_bin=jnp.zeros(nnode, jnp.int32),
+            default_left=jnp.zeros(nnode, bool),
+            left_child=jnp.zeros(nnode, jnp.int32),
+            right_child=jnp.zeros(nnode, jnp.int32),
+            split_gain=jnp.zeros(nnode, jnp.float32),
+            leaf_value=jnp.zeros(nleaf, jnp.float32).at[0].set(root_out),
+            leaf_weight=jnp.zeros(nleaf, jnp.float32).at[0].set(total0[1]),
+            leaf_count=jnp.zeros(nleaf, jnp.float32).at[0].set(total0[2]),
+            internal_value=jnp.zeros(nnode, jnp.float32),
+            internal_weight=jnp.zeros(nnode, jnp.float32),
+            internal_count=jnp.zeros(nnode, jnp.float32),
+            leaf_depth=jnp.zeros(nleaf, jnp.int32),
+            leaf_parent=jnp.full(nleaf, -1, jnp.int32),
             num_leaves=jnp.int32(1),
             done=jnp.bool_(False),
-            is_cat_node=jnp.zeros(L - 1, bool),
+            is_cat_node=jnp.zeros(nnode, bool),
             cat_rank=jnp.broadcast_to(
-                jnp.arange(B, dtype=jnp.int32)[None], (L - 1, B)) + 0,
+                jnp.arange(B, dtype=jnp.int32)[None], (nnode, B)) + 0,
         )
+
+    def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
+                  na_bin_part=None, is_cat=None,
+                  rng_iter=None) -> TreeArrays:
+        n, _f_global = binned.shape
+        binned_view = view_fn(binned)
+        f = binned_view.shape[1]
+        child_hist = _make_child_hist(n)
+        if na_bin_part is None:
+            na_bin_part = na_bin
+
+        hist0, total0, root_out, res0, et_key = _root_eval(
+            binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
+            rng_iter)
+        st = _init_state(n, L, L - 1, binned_view.shape[1], hist0, total0,
+                         root_out, res0)
 
         def split_step(i, st: _GrowState) -> _GrowState:
             leaf = jnp.argmax(st.bg).astype(jnp.int32)
@@ -441,4 +467,223 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             cat_rank=st.cat_rank,
         )
 
-    return jax.jit(grow_tree) if jit else grow_tree
+    K = max(1, min(int(split_batch), L - 1)) if L > 1 else 1
+
+    def grow_tree_batched(binned, vals, feature_mask, num_bin, na_bin,
+                          na_bin_part=None, is_cat=None,
+                          rng_iter=None) -> TreeArrays:
+        """K-splits-per-super-step grower (split_batch above).
+
+        Per-leaf state arrays carry K scratch slots past the real range
+        (leaves ``L..L+K-1``, nodes ``L-1..L-2+K``): slots of the top-K
+        batch whose cached gain is non-positive (or past the leaf budget)
+        are redirected there, so every step runs the same fixed-shape
+        program and the scratch writes are sliced off at the end."""
+        n, _f_global = binned.shape
+        binned_view = view_fn(binned)
+        fv = binned_view.shape[1]
+        if na_bin_part is None:
+            na_bin_part = na_bin
+        LP, NP = L + K, (L - 1) + K
+
+        hist0, total0, root_out, res0, et_key = _root_eval(
+            binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
+            rng_iter)
+        st = _init_state(n, LP, NP, fv, hist0, total0, root_out, res0)
+
+        neg_inf = jnp.float32(-jnp.inf)
+        kidx = jnp.arange(K, dtype=jnp.int32)
+        nC = K if use_subtraction else 2 * K
+
+        def super_step(s, st: _GrowState) -> _GrowState:
+            gains, leaves = lax.top_k(lax.slice_in_dim(st.bg, 0, L), K)
+            num_nodes = st.num_leaves - 1
+            budget = jnp.int32(L - 1) - num_nodes
+            # gains sorted desc and budget a prefix: valid slots are a
+            # prefix, so node/leaf id assignment below stays contiguous
+            valid = (gains > 0.0) & (kidx < budget) & (~st.done)
+            can_split = valid[0]
+
+            def do_split(st: _GrowState) -> _GrowState:
+                leaf_sel = jnp.where(valid, leaves, L + kidx)
+                node_sel = jnp.where(valid, num_nodes + kidx,
+                                     jnp.int32(L - 1) + kidx)
+                new_leaf_sel = jnp.where(valid, st.num_leaves + kidx,
+                                         L + kidx)
+
+                feat_k = st.bf[leaf_sel]
+                thr_k = st.bt[leaf_sel]
+                dleft_k = st.bdl[leaf_sel]
+                icat_k = st.bic[leaf_sel]
+                lsum_k, rsum_k = st.bls[leaf_sel], st.brs[leaf_sel]
+                rank_k = st.brank[leaf_sel]          # [K, B]
+                blo_k, bro_k = st.blo[leaf_sel], st.bro[leaf_sel]
+                parent_k = st.leaf_parent[leaf_sel]
+
+                # --- partition rows: ONE pass for all K splits ------------
+                slot_of_leaf = jnp.full(LP, -1, jnp.int32) \
+                    .at[leaf_sel].set(kidx)
+                slot = slot_of_leaf[st.leaf_of_row]          # [N]
+                active = slot >= 0
+                sl = jnp.maximum(slot, 0)
+                feat_r = feat_k[sl]                          # [N]
+                if efb is None:
+                    fcol = jnp.take_along_axis(
+                        binned, feat_r[:, None], axis=1)[:, 0] \
+                        .astype(jnp.int32)
+                else:
+                    grp_r = efb.group_of_feat[feat_r]
+                    gcol = jnp.take_along_axis(
+                        binned, grp_r[:, None], axis=1)[:, 0] \
+                        .astype(jnp.int32)
+                    off = efb_off_dev[feat_r]
+                    in_range = (gcol >= off) \
+                        & (gcol < off + num_bin[feat_r] - 1)
+                    fcol = jnp.where(off < 0, gcol,
+                                     jnp.where(in_range, gcol - off + 1, 0))
+                nb_r = na_bin_part[feat_r]
+                icat_r = icat_k[sl]
+                is_na = (nb_r >= 0) & (fcol == nb_r) & (~icat_r)
+                rv = rank_k[sl, fcol]
+                go_left = jnp.where(is_na, dleft_k[sl], rv <= thr_k[sl])
+                leaf_of_row = jnp.where(active & (~go_left),
+                                        new_leaf_sel[sl], st.leaf_of_row)
+
+                # --- batched child histograms: one C=3K contraction -------
+                smaller_left = lsum_k[:, 2] <= rsum_k[:, 2]  # [K]
+                small_id = jnp.where(smaller_left, leaf_sel, new_leaf_sel)
+                targets = small_id if use_subtraction \
+                    else jnp.concatenate([leaf_sel, new_leaf_sel])
+                tslot_of_leaf = jnp.full(LP, -1, jnp.int32) \
+                    .at[targets].set(jnp.arange(nC, dtype=jnp.int32))
+                tslot = tslot_of_leaf[leaf_of_row]           # [N]
+                onehot_t = (tslot[:, None]
+                            == jnp.arange(nC, dtype=jnp.int32)) \
+                    .astype(vals.dtype)                      # [N, nC]
+                vals_c = (vals[:, :, None] * onehot_t[:, None, :]) \
+                    .reshape(n, 3 * nC)
+                hist_c = _hist(binned_view, vals_c)          # [Fv, Bh, 3nC]
+                hist_c = hist_c.reshape(fv, Bh, 3, nC) \
+                    .transpose(3, 0, 1, 2)                   # [nC, Fv, Bh, 3]
+                if use_subtraction:
+                    hist_small = hist_c
+                    hist_large = st.hist[leaf_sel] - hist_small
+                    sel = smaller_left[:, None, None, None]
+                    hl_leaf = jnp.where(sel, hist_small, hist_large)
+                    hl_new = jnp.where(sel, hist_large, hist_small)
+                else:
+                    hl_leaf, hl_new = hist_c[:K], hist_c[K:]
+                hist = st.hist.at[leaf_sel].set(hl_leaf) \
+                              .at[new_leaf_sel].set(hl_new)
+
+                # --- leaf stats -------------------------------------------
+                d_k = st.leaf_depth[leaf_sel] + 1
+                lv = st.leaf_value.at[leaf_sel].set(blo_k) \
+                                  .at[new_leaf_sel].set(bro_k)
+                lw = st.leaf_weight.at[leaf_sel].set(lsum_k[:, 1]) \
+                                   .at[new_leaf_sel].set(rsum_k[:, 1])
+                lcnt = st.leaf_count.at[leaf_sel].set(lsum_k[:, 2]) \
+                                    .at[new_leaf_sel].set(rsum_k[:, 2])
+                ld = st.leaf_depth.at[leaf_sel].set(d_k) \
+                                  .at[new_leaf_sel].set(d_k)
+
+                # --- best splits for all 2K children (batched) ------------
+                hist2 = jnp.concatenate([hl_leaf, hl_new])   # [2K, ...]
+                tot2 = jnp.concatenate([lsum_k, rsum_k])
+                po2 = jnp.concatenate([blo_k, bro_k])
+                rand2 = None
+                if extra_trees:
+                    rand2 = _rand_bins(jax.random.fold_in(et_key, s + 1),
+                                       (2 * K, feature_mask.shape[0]),
+                                       num_bin)
+                r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
+                            na_bin, feature_mask, po2, is_cat, rand2)
+                d2 = jnp.concatenate([d_k, d_k])
+                depth_ok = (max_depth <= 0) | (d2 < max_depth)
+                valid2 = jnp.concatenate([valid, valid])
+                g2 = jnp.where(depth_ok & valid2, r2.gain, neg_inf)
+                idx2 = jnp.concatenate([leaf_sel, new_leaf_sel])
+
+                # --- tree bookkeeping (Tree::Split ×K) --------------------
+                node_ids = jnp.arange(NP, dtype=jnp.int32)
+                lc, rc = st.left_child, st.right_child
+                for j in range(K):       # static unroll over tiny arrays
+                    fix_l = (node_ids == parent_k[j]) \
+                        & (lc == ~leaf_sel[j])
+                    fix_r = (node_ids == parent_k[j]) \
+                        & (rc == ~leaf_sel[j])
+                    lc = jnp.where(fix_l, node_sel[j], lc)
+                    rc = jnp.where(fix_r, node_sel[j], rc)
+                lc = lc.at[node_sel].set(~leaf_sel)
+                rc = rc.at[node_sel].set(~new_leaf_sel)
+
+                return st._replace(
+                    leaf_of_row=leaf_of_row,
+                    hist=hist,
+                    bg=st.bg.at[idx2].set(g2),
+                    bf=st.bf.at[idx2].set(r2.feature),
+                    bt=st.bt.at[idx2].set(r2.threshold),
+                    bdl=st.bdl.at[idx2].set(r2.default_left),
+                    bls=st.bls.at[idx2].set(r2.left_sum),
+                    brs=st.brs.at[idx2].set(r2.right_sum),
+                    blo=st.blo.at[idx2].set(r2.left_output),
+                    bro=st.bro.at[idx2].set(r2.right_output),
+                    bic=st.bic.at[idx2].set(r2.is_cat),
+                    brank=st.brank.at[idx2].set(r2.bin_rank),
+                    split_feature=st.split_feature.at[node_sel].set(feat_k),
+                    threshold_bin=st.threshold_bin.at[node_sel].set(thr_k),
+                    default_left=st.default_left.at[node_sel].set(dleft_k),
+                    left_child=lc,
+                    right_child=rc,
+                    split_gain=st.split_gain.at[node_sel].set(
+                        jnp.where(valid, gains, 0.0)),
+                    leaf_value=lv, leaf_weight=lw, leaf_count=lcnt,
+                    internal_value=st.internal_value.at[node_sel].set(
+                        st.leaf_value[leaf_sel]),
+                    internal_weight=st.internal_weight.at[node_sel].set(
+                        st.leaf_weight[leaf_sel]),
+                    internal_count=st.internal_count.at[node_sel].set(
+                        st.leaf_count[leaf_sel]),
+                    leaf_depth=ld,
+                    leaf_parent=st.leaf_parent.at[leaf_sel].set(node_sel)
+                                              .at[new_leaf_sel].set(node_sel),
+                    num_leaves=st.num_leaves
+                    + valid.sum().astype(jnp.int32),
+                    done=st.done,
+                    is_cat_node=st.is_cat_node.at[node_sel].set(icat_k),
+                    cat_rank=st.cat_rank.at[node_sel].set(rank_k),
+                )
+
+            return lax.cond(can_split, do_split,
+                            lambda s: s._replace(done=jnp.bool_(True)), st)
+
+        # trip count must be L-1, not ceil((L-1)/K): a super-step splits
+        # only the leaves that HAVE positive gain (chain-shaped trees
+        # split one per step), so any static count below L-1 can stop a
+        # growable tree early.  Completed trees short-circuit: once the
+        # budget is exhausted ``can_split`` is False and every remaining
+        # step takes the no-op cond branch (a [L] top_k and a flag set),
+        # so balanced trees still pay ~(L-1)/K histogram passes.
+        st = lax.fori_loop(0, L - 1, super_step, st)
+        return TreeArrays(
+            num_leaves=st.num_leaves,
+            split_feature=st.split_feature[:L - 1],
+            threshold_bin=st.threshold_bin[:L - 1],
+            default_left=st.default_left[:L - 1],
+            left_child=st.left_child[:L - 1],
+            right_child=st.right_child[:L - 1],
+            split_gain=st.split_gain[:L - 1],
+            leaf_value=st.leaf_value[:L],
+            leaf_weight=st.leaf_weight[:L],
+            leaf_count=st.leaf_count[:L],
+            internal_value=st.internal_value[:L - 1],
+            internal_weight=st.internal_weight[:L - 1],
+            internal_count=st.internal_count[:L - 1],
+            leaf_depth=st.leaf_depth[:L],
+            leaf_of_row=st.leaf_of_row,
+            is_cat_node=st.is_cat_node[:L - 1],
+            cat_rank=st.cat_rank[:L - 1],
+        )
+
+    fn = grow_tree_batched if K > 1 else grow_tree
+    return jax.jit(fn) if jit else fn

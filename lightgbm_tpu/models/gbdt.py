@@ -240,13 +240,25 @@ class GBDTModel:
         else:
             self.binned_dev = jnp.asarray(feat_binned)
 
+        # split_batch resolution (config.py): 0 = auto -> strict leaf-wise
+        # below 64 leaves, 8-way super-steps above (PROFILE.md: the
+        # histogram contraction is sublane-bound at M=3; batching K leaves
+        # is the only way to raise that ceiling).  Voting stays strict:
+        # its per-split top-k feature votes are per-histogram-pass.
+        sb = config.split_batch
+        self._split_batch = sb if sb >= 1 else \
+            (8 if config.num_leaves >= 64 else 1)
+        if dist == "voting":
+            self._split_batch = 1
+
         if dist == "data":
             from ..parallel.data_parallel import make_dp_grower
             self.grower = make_dp_grower(
                 self._mesh, num_leaves=config.num_leaves,
                 num_bins=self.max_bin, params=self.split_params,
                 max_depth=config.max_depth, block_rows=config.rows_per_block,
-                efb=self.efb_dev if self._use_efb else None)
+                efb=self.efb_dev if self._use_efb else None,
+                split_batch=self._split_batch)
         elif dist == "voting":
             from ..parallel.voting_parallel import make_voting_grower
             self.grower = make_voting_grower(
@@ -260,7 +272,8 @@ class GBDTModel:
                 self._mesh, num_features=self.num_features + self._feat_pad,
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
-                block_rows=config.rows_per_block)
+                block_rows=config.rows_per_block,
+                split_batch=self._split_batch)
         elif hist_reduce is None and learner == "partitioned":
             # single-chip performance learner (grower_partitioned.py):
             # histogram work ∝ smaller child, like the reference
@@ -292,7 +305,8 @@ class GBDTModel:
                 block_rows=config.rows_per_block, hist_reduce=hist_reduce,
                 efb=self.efb_dev if self._use_efb else None,
                 gain_scale=contri, extra_trees=self._extra_trees,
-                extra_seed=config.extra_seed)
+                extra_seed=config.extra_seed,
+                split_batch=self._split_batch)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
             raise ValueError("linear_tree requires boosting=gbdt")
@@ -678,6 +692,7 @@ class GBDTModel:
                 efb=self.efb_dev if self._use_efb else None,
                 gain_scale=self._feature_contri,
                 extra_trees=self._extra_trees, extra_seed=cfg.extra_seed,
+                split_batch=self._split_batch,
                 jit=False)
             obj = self.objective
             lr = jnp.float32(self.learning_rate)

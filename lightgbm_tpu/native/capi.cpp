@@ -395,4 +395,163 @@ int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
                                    out_result);
 }
 
+// CSR prediction (LGBM_BoosterPredictForCSR, c_api.h:815): each sparse
+// row is densified into a per-thread scratch row (absent entries are 0.0,
+// matching the reference's sparse missing-as-zero semantics) and pushed
+// through the same tree walk.
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const int32_t* indptr,
+                              int64_t nindptr, const int32_t* indices,
+                              const double* data, int64_t nelem, int64_t ncol,
+                              int predict_type, int start_iteration,
+                              int num_iteration, int64_t* out_len,
+                              double* out_result) {
+  const Booster* b = static_cast<Booster*>(handle);
+  if (ncol < b->max_feature_idx + 1)
+    return SetError("ncol smaller than the model's feature count");
+  int t0, t1;
+  int used = ResolveIterRange(b, start_iteration, num_iteration, &t0, &t1);
+  int width = predict_type == kLeafIndex ? used : b->num_class;
+  int64_t nrow = nindptr - 1;
+#ifdef _OPENMP
+#pragma omp parallel
+#endif
+  {
+    std::vector<double> row(ncol, 0.0);
+#ifdef _OPENMP
+#pragma omp for schedule(static)
+#endif
+    for (int64_t i = 0; i < nrow; ++i) {
+      for (int64_t e = indptr[i]; e < indptr[i + 1]; ++e)
+        row[indices[e]] = data[e];
+      b->PredictRow(row.data(), t0, t1, predict_type, out_result + i * width);
+      for (int64_t e = indptr[i]; e < indptr[i + 1]; ++e)
+        row[indices[e]] = 0.0;
+    }
+  }
+  if (out_len) *out_len = nrow * width;
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
+                                       const int32_t* indptr, int64_t nindptr,
+                                       const int32_t* indices,
+                                       const double* data, int64_t nelem,
+                                       int64_t ncol, int predict_type,
+                                       int start_iteration, int num_iteration,
+                                       int64_t* out_len, double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, nindptr, indices, data,
+                                   nelem, ncol, predict_type, start_iteration,
+                                   num_iteration, out_len, out_result);
+}
+
+// File prediction (LGBM_BoosterPredictForFile, c_api.h:749): CSV/TSV or
+// LibSVM rows (detected by the presence of ':' pairs), results one
+// prediction per line.  LibSVM indexing base is auto-detected by scanning
+// the head of the file for a "0:" feature id (zero-based) — classic
+// LibSVM / sklearn dump_svmlight_file emit one-based ids, which are
+// shifted down by one; mirrors the Atof-based index probing the
+// reference's parser does when choosing a parser.
+// Scans the WHOLE file: zero-based is provable (a "0:" id somewhere),
+// one-based only assumable — a zero-based file whose feature 0 is absent
+// everywhere is indistinguishable from a one-based file missing its last
+// feature (the same ambiguity sklearn's zero_based="auto" accepts).
+static int DetectLibsvmBase(std::ifstream* in) {
+  std::string line;
+  int base = 1;
+  while (base == 1 && std::getline(*in, line)) {
+    size_t sp = line.find_first_of(" \t");
+    while (sp != std::string::npos) {
+      size_t tok_end = line.find_first_of(" \t", sp + 1);
+      std::string tok = line.substr(sp + 1, tok_end == std::string::npos
+                                                 ? std::string::npos
+                                                 : tok_end - sp - 1);
+      size_t c = tok.find(':');
+      if (c != std::string::npos && tok.substr(0, c) == "0") {
+        base = 0;
+        break;
+      }
+      sp = tok_end;
+    }
+  }
+  in->clear();
+  in->seekg(0);
+  return base;
+}
+
+int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* result_filename) {
+  const Booster* b = static_cast<Booster*>(handle);
+  std::ifstream in(data_filename);
+  if (!in)
+    return SetError(std::string("cannot open data file: ") + data_filename);
+  // format is decided ONCE per file from the first data line (a LibSVM
+  // row with zero feature pairs would otherwise fall into the CSV branch,
+  // and a CSV field containing ':' into the LibSVM branch); the base scan
+  // only runs for LibSVM input
+  bool libsvm = false;
+  {
+    std::string probe;
+    int skip = data_has_header ? 1 : 0;
+    while (std::getline(in, probe)) {
+      if (skip-- > 0 || probe.empty()) continue;
+      libsvm = probe.find(':') != std::string::npos;
+      break;
+    }
+    in.clear();
+    in.seekg(0);
+  }
+  int svm_base = libsvm ? DetectLibsvmBase(&in) : 1;
+  std::ofstream out(result_filename);
+  if (!out)
+    return SetError(std::string("cannot open result file: ") + result_filename);
+  out.precision(17);
+  int t0, t1;
+  int used = ResolveIterRange(b, start_iteration, num_iteration, &t0, &t1);
+  int width = predict_type == kLeafIndex ? used : b->num_class;
+  int ncol = b->max_feature_idx + 1;
+  std::vector<double> row(ncol), pred(width);
+  std::string line;
+  bool first = true;
+  try {
+    while (std::getline(in, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (first && data_has_header) { first = false; continue; }
+      first = false;
+      if (line.empty()) continue;
+      std::fill(row.begin(), row.end(), 0.0);
+      std::istringstream is(line);
+      std::string tok;
+      char sep = line.find('\t') != std::string::npos ? '\t' : ',';
+      if (libsvm) {
+        double label;  // leading label column, ignored
+        is >> label;
+        while (is >> tok) {
+          size_t c = tok.find(':');
+          if (c == std::string::npos) continue;
+          int f = std::stoi(tok.substr(0, c)) - svm_base;
+          if (f >= 0 && f < ncol) row[f] = std::stod(tok.substr(c + 1));
+        }
+      } else {
+        // first column is the label (reference predict task convention
+        // when label_column is default), remaining are features
+        int col = -1;
+        while (std::getline(is, tok, sep)) {
+          if (col >= 0 && col < ncol)
+            row[col] = tok.empty() ? std::nan("") : std::stod(tok);
+          ++col;
+        }
+      }
+      b->PredictRow(row.data(), t0, t1, predict_type, pred.data());
+      for (int k = 0; k < width; ++k)
+        out << (k ? "\t" : "") << pred[k];
+      out << "\n";
+    }
+  } catch (const std::exception& e) {
+    return SetError(std::string("parse error in data file: ") + e.what());
+  }
+  return 0;
+}
+
 }  // extern "C"

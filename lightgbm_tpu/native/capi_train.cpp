@@ -16,6 +16,7 @@
 
 #include <Python.h>
 
+#include <cstdint>
 #include <cstring>
 #include <string>
 
@@ -139,6 +140,112 @@ int LGBM_TrainDatasetCreateFromFile(const char* filename,
   Py_DECREF(args);
   if (!r) return PyError();
   *out = r;
+  return 0;
+}
+
+// CSR dataset construction (LGBM_DatasetCreateFromCSR, c_api.h:200).
+// indptr is int32[nindptr]; indices int32[nelem]; data double[nelem].
+int LGBM_TrainDatasetCreateFromCSR(const int32_t* indptr, int64_t nindptr,
+                                   const int32_t* indices, const double* data,
+                                   int64_t nelem, int64_t ncol,
+                                   const char* parameters,
+                                   DatasetHandle reference,
+                                   DatasetHandle* out) {
+  Gil gil;
+  PyObject* ip = View(indptr, nindptr * 4);
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * 8);
+  PyObject* ref = reference ? reinterpret_cast<PyObject*>(reference) : Py_None;
+  PyObject* args = Py_BuildValue("(OLOOLLsO)", ip, (long long)nindptr, ix, dv,
+                                 (long long)nelem, (long long)ncol,
+                                 parameters ? parameters : "", ref);
+  Py_DECREF(ip);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  PyObject* r = Call("dataset_create_from_csr", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+// CSC dataset construction (LGBM_DatasetCreateFromCSC, c_api.h:268).
+int LGBM_TrainDatasetCreateFromCSC(const int32_t* indptr, int64_t nindptr,
+                                   const int32_t* indices, const double* data,
+                                   int64_t nelem, int64_t nrow,
+                                   const char* parameters,
+                                   DatasetHandle reference,
+                                   DatasetHandle* out) {
+  Gil gil;
+  PyObject* ip = View(indptr, nindptr * 4);
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * 8);
+  PyObject* ref = reference ? reinterpret_cast<PyObject*>(reference) : Py_None;
+  PyObject* args = Py_BuildValue("(OLOOLLsO)", ip, (long long)nindptr, ix, dv,
+                                 (long long)nelem, (long long)nrow,
+                                 parameters ? parameters : "", ref);
+  Py_DECREF(ip);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  PyObject* r = Call("dataset_create_from_csc", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+// Streaming construction (LGBM_DatasetCreateFromSampledColumn +
+// LGBM_DatasetPushRows[ByCSR], c_api.h:109-313): pre-size the dataset,
+// push row chunks from any producer, finalize implicitly on first use.
+int LGBM_TrainDatasetCreateStreaming(int64_t nrow, int32_t ncol,
+                                     const char* parameters,
+                                     DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Lis)", (long long)nrow, (int)ncol,
+                                 parameters ? parameters : "");
+  PyObject* r = Call("dataset_create_streaming", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+int LGBM_TrainDatasetPushRows(DatasetHandle handle, const double* data,
+                              int32_t nrow, int32_t ncol,
+                              int32_t start_row) {
+  Gil gil;
+  PyObject* mv = View(data, static_cast<Py_ssize_t>(nrow) * ncol * 8);
+  PyObject* args = Py_BuildValue("(OOiii)",
+                                 reinterpret_cast<PyObject*>(handle), mv,
+                                 (int)nrow, (int)ncol, (int)start_row);
+  Py_DECREF(mv);
+  PyObject* r = Call("dataset_push_rows", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_TrainDatasetPushRowsByCSR(DatasetHandle handle,
+                                   const int32_t* indptr, int64_t nindptr,
+                                   const int32_t* indices,
+                                   const double* data, int64_t nelem,
+                                   int32_t start_row) {
+  Gil gil;
+  PyObject* ip = View(indptr, nindptr * 4);
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * 8);
+  PyObject* args = Py_BuildValue("(OOLOOLi)",
+                                 reinterpret_cast<PyObject*>(handle), ip,
+                                 (long long)nindptr, ix, dv,
+                                 (long long)nelem, (int)start_row);
+  Py_DECREF(ip);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  PyObject* r = Call("dataset_push_rows_by_csr", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
   return 0;
 }
 
@@ -331,6 +438,124 @@ int LGBM_TrainBoosterPredictForMat(BoosterHandle handle, const double* data,
   Py_DECREF(r);
   if (len == -1 && PyErr_Occurred()) return PyError();
   *out_len = len;
+  return 0;
+}
+
+// CSR prediction (LGBM_BoosterPredictForCSR, c_api.h:815).
+int LGBM_TrainBoosterPredictForCSR(BoosterHandle handle,
+                                   const int32_t* indptr, int64_t nindptr,
+                                   const int32_t* indices, const double* data,
+                                   int64_t nelem, int64_t ncol,
+                                   int predict_type, int start_iteration,
+                                   int num_iteration, int64_t out_capacity,
+                                   double* out_result, int64_t* out_len) {
+  Gil gil;
+  PyObject* ip = View(indptr, nindptr * 4);
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * 8);
+  PyObject* out_mv = View(out_result, out_capacity * 8, /*writable=*/true);
+  PyObject* args = Py_BuildValue("(OOLOOLLiiiO)",
+                                 reinterpret_cast<PyObject*>(handle), ip,
+                                 (long long)nindptr, ix, dv,
+                                 (long long)nelem, (long long)ncol,
+                                 predict_type, start_iteration,
+                                 num_iteration, out_mv);
+  Py_DECREF(ip);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  Py_DECREF(out_mv);
+  PyObject* r = Call("booster_predict_csr", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  long long len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  if (len == -1 && PyErr_Occurred()) return PyError();
+  *out_len = len;
+  return 0;
+}
+
+int LGBM_TrainBoosterGetNumFeature(BoosterHandle handle, int* out) {
+  Gil gil;
+  return GetInt("booster_num_feature", reinterpret_cast<PyObject*>(handle),
+                out);
+}
+
+// tab-separated metric names (LGBM_BoosterGetEvalNames analog)
+int LGBM_TrainBoosterGetEvalNames(BoosterHandle handle,
+                                  const char** out_str) {
+  Gil gil;
+  static thread_local std::string buf;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle));
+  PyObject* r = Call("booster_get_eval_names", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  const char* p = PyUnicode_AsUTF8(r);
+  if (!p) {
+    Py_DECREF(r);
+    return PyError();
+  }
+  buf = p;
+  Py_DECREF(r);
+  *out_str = buf.c_str();
+  return 0;
+}
+
+// importance_type: 0 split, 1 gain (LGBM_BoosterFeatureImportance)
+int LGBM_TrainBoosterFeatureImportance(BoosterHandle handle,
+                                       int importance_type,
+                                       int64_t out_capacity,
+                                       double* out_result, int* out_len) {
+  Gil gil;
+  PyObject* out_mv = View(out_result, out_capacity * 8, /*writable=*/true);
+  PyObject* args = Py_BuildValue("(OiO)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 importance_type, out_mv);
+  Py_DECREF(out_mv);
+  PyObject* r = Call("booster_feature_importance", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (v == -1 && PyErr_Occurred()) return PyError();
+  *out_len = static_cast<int>(v);
+  return 0;
+}
+
+int LGBM_TrainBoosterResetParameter(BoosterHandle handle,
+                                    const char* parameters) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                                 parameters ? parameters : "");
+  PyObject* r = Call("booster_reset_parameter", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+// LGBM_NetworkInit (c_api.h:1350): brings up the jax.distributed runtime
+// over the reference's "ip1:port1,ip2:port2" machines format; the XLA
+// collectives then ride it (SURVEY.md §2.5 TPU mapping).
+int LGBM_TrainNetworkInit(const char* machines, int local_listen_port,
+                          int listen_time_out, int num_machines) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(siii)", machines ? machines : "",
+                                 local_listen_port, listen_time_out,
+                                 num_machines);
+  PyObject* r = Call("network_init", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_TrainNetworkFree() {
+  Gil gil;
+  PyObject* args = Py_BuildValue("()");
+  PyObject* r = Call("network_free", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
   return 0;
 }
 

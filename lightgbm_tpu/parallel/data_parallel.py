@@ -46,7 +46,8 @@ def shard_rows(mesh: Mesh, arr, axis: str = "data"):
 
 def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    params: SplitParams, max_depth: int = -1,
-                   block_rows: int = 0, axis: str = "data", efb=None):
+                   block_rows: int = 0, axis: str = "data", efb=None,
+                   split_batch: int = 1):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
     Inputs: binned [N, F] (or the bundled [N, G] group matrix when ``efb``
@@ -63,7 +64,8 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
         hist_reduce=lambda h: lax.psum(h, axis),
-        sum_reduce=lambda t: lax.psum(t, axis), efb=efb, jit=False)
+        sum_reduce=lambda t: lax.psum(t, axis), efb=efb,
+        split_batch=split_batch, jit=False)
 
     out_specs = TreeArrays(
         num_leaves=P(), split_feature=P(), threshold_bin=P(),

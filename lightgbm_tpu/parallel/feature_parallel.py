@@ -26,7 +26,8 @@ from ..ops.split import SplitParams, SplitResult
 
 def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
                    num_bins: int, params: SplitParams, max_depth: int = -1,
-                   block_rows: int = 0, axis: str = "feature"):
+                   block_rows: int = 0, axis: str = "feature",
+                   split_batch: int = 1):
     """Jitted feature-parallel ``grow_tree``.
 
     Inputs: binned [N, F] and vals replicated; feature metadata arrays
@@ -58,7 +59,8 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
-        hist_view=hist_view, select_best=select_best, jit=False)
+        hist_view=hist_view, select_best=select_best,
+        split_batch=split_batch, jit=False)
 
     out_specs = jax.tree.map(lambda _: P(), TreeArrays(
         *(0,) * len(TreeArrays._fields)))

@@ -114,3 +114,50 @@ def test_error_on_corrupt_numeric_field():
            "Tree=0\nnum_leaves=abc\n")
     with pytest.raises(RuntimeError):
         NativeBooster(model_str=bad)
+
+
+def test_csr_predict_parity(tmp_path):
+    from scipy.sparse import csr_matrix
+    rng = np.random.RandomState(2)
+    x = rng.randn(400, 8)
+    x[rng.rand(*x.shape) < 0.7] = 0.0
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 15}, x, y)
+    nb = _roundtrip(bst, tmp_path)
+    xs = csr_matrix(x)
+    np.testing.assert_allclose(nb.predict(xs), nb.predict(x),
+                               rtol=1e-12, atol=0)
+    np.testing.assert_allclose(nb.predict(xs), bst.predict(x),
+                               rtol=2e-5, atol=1e-7)
+    # leaf indices via CSR too
+    np.testing.assert_array_equal(nb.predict(xs, pred_leaf=True),
+                                  nb.predict(x, pred_leaf=True))
+
+
+def test_predict_file_csv_and_libsvm(tmp_path):
+    rng = np.random.RandomState(3)
+    x = rng.randn(120, 5)
+    y = (x[:, 0] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 7}, x, y, rounds=5)
+    nb = _roundtrip(bst, tmp_path)
+    ref = nb.predict(x)
+
+    # CSV with leading label column (the reference predict-task layout)
+    csv = tmp_path / "data.csv"
+    with open(csv, "w") as f:
+        for i in range(x.shape[0]):
+            f.write(",".join([str(y[i])] + [f"{v:.17g}" for v in x[i]]) + "\n")
+    out_csv = tmp_path / "pred_csv.txt"
+    nb.predict_file(str(csv), str(out_csv))
+    got = np.loadtxt(out_csv)
+    np.testing.assert_allclose(got, ref, rtol=1e-9)
+
+    # LibSVM (zero-based feature ids)
+    svm = tmp_path / "data.svm"
+    with open(svm, "w") as f:
+        for i in range(x.shape[0]):
+            pairs = " ".join(f"{j}:{x[i, j]:.17g}" for j in range(x.shape[1]))
+            f.write(f"{y[i]} {pairs}\n")
+    out_svm = tmp_path / "pred_svm.txt"
+    nb.predict_file(str(svm), str(out_svm))
+    np.testing.assert_allclose(np.loadtxt(out_svm), ref, rtol=1e-9)

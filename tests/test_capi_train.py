@@ -20,17 +20,22 @@ SRC = os.path.join(os.path.dirname(lgb.__file__), "native", "capi_train.cpp")
 def _ensure_built() -> str:
     """Build libcapi_train.so on demand (VERDICT r2: a stale-path skipif
     meant these tests silently guarded nothing; now only a FAILING build
-    skips, with the compiler error in the reason)."""
+    skips, with the compiler error in the reason).  Flags come from THIS
+    interpreter's sysconfig — `python3-config` on PATH may belong to a
+    different Python, and an .so embedding a mismatched libpython
+    corrupts the test process instead of skipping."""
     if os.path.exists(SO) and os.path.getmtime(SO) >= os.path.getmtime(SRC):
         return ""
-    inc = subprocess.run(["python3-config", "--includes"],
-                         capture_output=True, text=True)
-    ld = subprocess.run(["python3-config", "--ldflags", "--embed"],
-                        capture_output=True, text=True)
-    if inc.returncode != 0 or ld.returncode != 0:
-        return "python3-config unavailable"
-    cmd = (["g++", "-O2", "-shared", "-fPIC", SRC, "-o", SO]
-           + inc.stdout.split() + ld.stdout.split())
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") \
+        or sysconfig.get_config_var("VERSION")
+    if not inc or not ver:
+        return "sysconfig lacks include/version info"
+    cmd = (["g++", "-O2", "-shared", "-fPIC", SRC, "-o", SO, f"-I{inc}"]
+           + ([f"-L{libdir}"] if libdir else [])
+           + [f"-lpython{ver}"]
+           + (sysconfig.get_config_var("LIBS") or "").split())
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     if r.returncode != 0:
         return f"build failed: {r.stderr[-400:]}"
@@ -194,3 +199,196 @@ def test_pure_c_host(tmp_path):
     # the saved model loads back in the Python API
     bst = lgb.Booster(model_file=str(model))
     assert bst.current_iteration == 5
+
+
+# ---------------------------------------------------------------------------
+# round-3 surface: CSR/CSC/streaming dataset create, CSR predict, getters,
+# reset-parameter, network init (c_api.h:109-313, 815, 1350)
+# ---------------------------------------------------------------------------
+
+def _lib():
+    lib = ctypes.CDLL(SO)
+    lib.LGBM_TrainGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _csr_parts(x):
+    from scipy.sparse import csr_matrix
+    m = csr_matrix(x)
+    return (np.ascontiguousarray(m.indptr, np.int32),
+            np.ascontiguousarray(m.indices, np.int32),
+            np.ascontiguousarray(m.data, np.float64))
+
+
+def _train_c(lib, ds, rounds=8,
+             params=b"objective=binary num_leaves=15 verbosity=-1"):
+    bst = ctypes.c_void_p()
+    rc = lib.LGBM_TrainBoosterCreate(ds, params, ctypes.byref(bst))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    fin = ctypes.c_int()
+    for _ in range(rounds):
+        assert lib.LGBM_TrainBoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+    s = ctypes.c_char_p()
+    assert lib.LGBM_TrainBoosterSaveModelToString(bst, 0, -1,
+                                                  ctypes.byref(s)) == 0
+    return bst, s.value.decode()
+
+
+def test_csr_create_and_predict():
+    lib = _lib()
+    x, y = _data(n=800, f=6, seed=3)
+    x[np.random.RandomState(0).rand(*x.shape) < 0.6] = 0.0  # sparsify
+    indptr, indices, data = _csr_parts(x)
+
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_TrainDatasetCreateFromCSR(
+        indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(len(indptr)),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(len(data)),
+        ctypes.c_int64(x.shape[1]), b"max_bin=63 verbosity=-1", None,
+        ctypes.byref(ds))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    assert lib.LGBM_TrainDatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), len(y), 0) == 0
+    bst, model_str = _train_c(lib, ds)
+
+    # CSR predict == dense predict == Python predict on the same model
+    n = x.shape[0]
+    out = np.zeros(n, np.float64)
+    out_len = ctypes.c_int64()
+    rc = lib.LGBM_TrainBoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(len(indptr)),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(len(data)),
+        ctypes.c_int64(x.shape[1]), 0, 0, -1, ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    assert out_len.value == n
+    ref = lgb.Booster(model_str=model_str).predict(x)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-8)
+    lib.LGBM_TrainBoosterFree(bst)
+    lib.LGBM_TrainDatasetFree(ds)
+
+
+def test_csc_create_matches_dense():
+    lib = _lib()
+    x, y = _data(n=600, f=5, seed=4)
+    from scipy.sparse import csc_matrix
+    m = csc_matrix(x)
+    indptr = np.ascontiguousarray(m.indptr, np.int32)
+    indices = np.ascontiguousarray(m.indices, np.int32)
+    data = np.ascontiguousarray(m.data, np.float64)
+
+    ds = ctypes.c_void_p()
+    rc = lib.LGBM_TrainDatasetCreateFromCSC(
+        indptr.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(len(indptr)),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(len(data)),
+        ctypes.c_int64(x.shape[0]), b"max_bin=63 verbosity=-1", None,
+        ctypes.byref(ds))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    assert lib.LGBM_TrainDatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), len(y), 0) == 0
+    _, model_csc = _train_c(lib, ds)
+
+    ds2 = ctypes.c_void_p()
+    assert lib.LGBM_TrainDatasetCreateFromMat(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), x.shape[0],
+        x.shape[1], b"max_bin=63 verbosity=-1", None, ctypes.byref(ds2)) == 0
+    assert lib.LGBM_TrainDatasetSetField(
+        ds2, b"label", y.ctypes.data_as(ctypes.c_void_p), len(y), 0) == 0
+    _, model_dense = _train_c(lib, ds2)
+    # CSC zeros become missing-type zero bins exactly like dense zeros
+    assert model_csc.split("\n\n")[1] == model_dense.split("\n\n")[1]
+
+
+def test_streaming_push_rows_matches_dense():
+    lib = _lib()
+    x, y = _data(n=1000, f=5, seed=5)
+    n, f = x.shape
+
+    sd = ctypes.c_void_p()
+    rc = lib.LGBM_TrainDatasetCreateStreaming(
+        ctypes.c_int64(n), f, b"max_bin=63 verbosity=-1", ctypes.byref(sd))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    for start in range(0, n, 300):           # push in 300-row chunks
+        chunk = np.ascontiguousarray(x[start:start + 300])
+        rc = lib.LGBM_TrainDatasetPushRows(
+            sd, chunk.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            chunk.shape[0], f, start)
+        assert rc == 0, lib.LGBM_TrainGetLastError()
+    assert lib.LGBM_TrainDatasetSetField(
+        sd, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0) == 0
+    nd = ctypes.c_int()
+    assert lib.LGBM_TrainDatasetGetNumData(sd, ctypes.byref(nd)) == 0
+    assert nd.value == n
+    _, model_stream = _train_c(lib, sd)
+
+    ds2 = ctypes.c_void_p()
+    assert lib.LGBM_TrainDatasetCreateFromMat(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, f,
+        b"max_bin=63 verbosity=-1", None, ctypes.byref(ds2)) == 0
+    assert lib.LGBM_TrainDatasetSetField(
+        ds2, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0) == 0
+    # construct both datasets at the same phase (GetNumData) so train-time
+    # feature pre-filtering can't differ between the two paths
+    assert lib.LGBM_TrainDatasetGetNumData(ds2, ctypes.byref(nd)) == 0
+    _, model_dense = _train_c(lib, ds2)
+    assert model_stream.split("\n\n")[1] == model_dense.split("\n\n")[1]
+
+
+def test_booster_getters_and_reset_parameter():
+    lib = _lib()
+    x, y = _data(n=600, f=5, seed=6)
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_TrainDatasetCreateFromMat(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), x.shape[0],
+        x.shape[1], b"max_bin=63 verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_TrainDatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), len(y), 0) == 0
+    bst, _ = _train_c(lib, ds, rounds=3)
+
+    nf = ctypes.c_int()
+    assert lib.LGBM_TrainBoosterGetNumFeature(bst, ctypes.byref(nf)) == 0
+    assert nf.value == 5
+
+    names = ctypes.c_char_p()
+    assert lib.LGBM_TrainBoosterGetEvalNames(bst, ctypes.byref(names)) == 0
+    assert b"binary_logloss" in names.value
+
+    imp = np.zeros(5, np.float64)
+    out_n = ctypes.c_int()
+    rc = lib.LGBM_TrainBoosterFeatureImportance(
+        bst, 0, ctypes.c_int64(5),
+        imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_n))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    assert out_n.value == 5 and imp.sum() > 0
+
+    # learning-rate reset applies to FUTURE trees only
+    assert lib.LGBM_TrainBoosterResetParameter(
+        bst, b"learning_rate=0.77") == 0
+    fin = ctypes.c_int()
+    assert lib.LGBM_TrainBoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+    s = ctypes.c_char_p()
+    assert lib.LGBM_TrainBoosterSaveModelToString(bst, 0, -1,
+                                                  ctypes.byref(s)) == 0
+    txt = s.value.decode()
+    assert "shrinkage=0.77" in txt and "shrinkage=0.1" in txt
+    # structural params are refused, with the error reported through
+    # LGBM_TrainGetLastError
+    assert lib.LGBM_TrainBoosterResetParameter(bst, b"num_leaves=63") == -1
+    assert b"num_leaves" in lib.LGBM_TrainGetLastError()
+
+
+def test_network_init_validation():
+    lib = _lib()
+    # bad machine-count mismatch surfaces as an error, not a crash
+    rc = lib.LGBM_TrainNetworkInit(b"127.0.0.1:9999", 9999, 120, 3)
+    assert rc == -1
+    assert b"3" in lib.LGBM_TrainGetLastError()
+    # single machine is a no-op success (reference behavior)
+    assert lib.LGBM_TrainNetworkInit(b"", 12400, 120, 1) == 0
+    assert lib.LGBM_TrainNetworkFree() == 0

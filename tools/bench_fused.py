@@ -1,6 +1,6 @@
 """Measure fused-chunk training throughput on the real TPU.
 
-Run: python tools/bench_fused.py [n_rows] [num_leaves] [chunk]
+Run: python tools/bench_fused.py [n_rows] [num_leaves] [chunk] [split_batch]
 """
 
 import sys
@@ -15,6 +15,7 @@ def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     num_leaves = int(sys.argv[2]) if len(sys.argv) > 2 else 31
     chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+    split_batch = int(sys.argv[4]) if len(sys.argv) > 4 else 1
 
     rng = np.random.RandomState(0)
     f = 28
@@ -29,7 +30,8 @@ def main():
 
     params = {"objective": "binary", "num_leaves": num_leaves,
               "learning_rate": 0.1, "max_bin": 63, "min_data_in_leaf": 20,
-              "verbosity": 0, "fused_chunk": chunk}
+              "verbosity": 0, "fused_chunk": chunk,
+              "split_batch": split_batch}
     t0 = time.time()
     ds = lgb.Dataset(x, label=y, params=params)   # bin at the CLAIMED max_bin
     ds.construct()
