@@ -186,7 +186,6 @@ _PARAMS: Dict[str, tuple] = {
     # different (still best-first) growth order.  0 = auto: 1 below 64
     # leaves, then 8.
     "split_batch": (int, 0, []),
-    "use_pallas": (bool, True, []),          # use Pallas kernels where available
     # ---- IO / task ----
     "task": (str, "train", ["task_type"]),
     "data": (str, "", ["train", "train_data", "train_data_file", "data_filename"]),

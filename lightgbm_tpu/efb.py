@@ -55,7 +55,7 @@ def find_bundles(sample_bins: np.ndarray, num_bin: np.ndarray,
     Only numerical features whose default (most frequent) bin is 0 and whose
     non-default rate is <= dense_rate are bundling candidates; everything
     else gets a singleton group.  ``max_group_bins`` bounds a bundle's bin
-    axis so the Pallas histogram tile (hist_pallas.py, [block, group_bins]
+    axis so the histogram row-block tile ([block, group_bins]
     in VMEM) stays well under the ~16 MB VMEM budget — oversize bundles are
     split into multiple groups automatically.
     """

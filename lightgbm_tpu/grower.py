@@ -61,6 +61,9 @@ class TreeArrays(NamedTuple):
 class _GrowState(NamedTuple):
     leaf_of_row: jax.Array
     hist: jax.Array              # [L, F, B, 3]
+    # per-leaf allowed output range (monotone 'basic' method; ±inf w/o)
+    olo: jax.Array               # [L] f32
+    ohi: jax.Array               # [L] f32
     # per-leaf best-split candidates
     bg: jax.Array                # [L] gain
     bf: jax.Array                # [L] feature
@@ -106,6 +109,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 gain_scale=None,
                 extra_trees: bool = False, extra_seed: int = 6,
                 split_batch: int = 1,
+                mono=None, mono_penalty: float = 0.0,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
@@ -142,6 +146,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       totals reconstructing the shared default bin (FixHistogram,
       dataset.cpp:1292).  Row partitioning decodes the winning feature's
       bins from its group column.
+    - mono/mono_penalty: [F] -1/0/+1 monotone constraints, 'basic' method
+      (monotone_constraints.hpp BasicLeafConstraints): per-leaf allowed
+      output ranges tracked ON DEVICE ([L] lo/hi vectors in the grow
+      state), split candidates clamped+filtered in the split scan, child
+      ranges bounded by the split midpoint.  Works under hist_reduce
+      (data-parallel monotone, which the reference supports in all
+      parallel learners) because ranges derive from replicated split
+      decisions.  mono_penalty applies the depth-based gain de-rating
+      (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355).
     - split_batch=K>1: grow K leaves per super-step instead of strictly
       one.  Each step picks the top-K leaves by cached best gain, applies
       all K splits in one row-partition pass, and builds all K smaller
@@ -218,6 +231,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
     gscale = None if gain_scale is None else jnp.asarray(gain_scale,
                                                          jnp.float32)
+    mono_dev = None if mono is None else jnp.asarray(mono, jnp.int32)
+    use_mono = mono_dev is not None
 
     def _rand_bins(key, shape, num_bin):
         """extra_trees (feature_histogram.hpp:116): one random threshold
@@ -226,19 +241,61 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         span = jnp.maximum(num_bin - 1, 1).astype(jnp.float32)
         return jnp.minimum((u * span).astype(jnp.int32), num_bin - 2)
 
+    def _mono_gain_scale(depth):
+        """Depth-based penalty factor on monotone features
+        (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355);
+        returns a per-feature [F] scale, composed with ``gain_scale``."""
+        pen = float(mono_penalty)
+        d = depth.astype(jnp.float32)
+        factor = jnp.where(
+            pen >= d + 1.0, 1e-15,
+            jnp.where(pen <= 1.0, 1.0 - pen / (2.0 ** d) + 1e-15,
+                      1.0 - 2.0 ** (pen - 1.0 - d) + 1e-15))
+        gs = jnp.where(mono_dev != 0, factor, 1.0).astype(jnp.float32)
+        return gs if gscale is None else gs * gscale
+
     def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2, is_cat,
-               rand2=None):
-        if rand2 is None:
-            return jax.vmap(
-                lambda h, t, po: select_fn(
-                    find_best_split(h, t, num_bin, na_bin, fmask, params,
-                                    po, is_cat, gain_scale=gscale))
-            )(hist2, totals2, parent_out2)
-        return jax.vmap(
-            lambda h, t, po, rb: select_fn(
-                find_best_split(h, t, num_bin, na_bin, fmask, params, po,
-                                is_cat, gain_scale=gscale, rand_bin=rb))
-        )(hist2, totals2, parent_out2, rand2)
+               rand2=None, lo2=None, hi2=None, depth2=None):
+        """Vmapped best-split over a batch of candidate leaves; optional
+        per-leaf extra_trees random bins and monotone output ranges."""
+        extras, axes = [], []
+        if rand2 is not None:
+            extras.append(rand2)
+            axes.append(0)
+        if use_mono:
+            extras += [lo2, hi2, depth2]
+            axes += [0, 0, 0]
+
+        def one(h, t, po, *rest):
+            i = 0
+            kw = {}
+            if rand2 is not None:
+                kw["rand_bin"] = rest[i]
+                i += 1
+            if use_mono:
+                lo, hi, d = rest[i], rest[i + 1], rest[i + 2]
+                kw.update(mono=mono_dev, out_lo=lo, out_hi=hi)
+                kw["gain_scale"] = _mono_gain_scale(d) \
+                    if mono_penalty > 0.0 else gscale
+            else:
+                kw["gain_scale"] = gscale
+            return select_fn(find_best_split(h, t, num_bin, na_bin, fmask,
+                                             params, po, is_cat, **kw))
+
+        return jax.vmap(one, in_axes=(0, 0, 0) + tuple(axes))(
+            hist2, totals2, parent_out2, *extras)
+
+    def _child_ranges(lo_p, hi_p, mc, icat, mid):
+        """BasicLeafConstraints child range propagation: a +1 split caps
+        the left child at the midpoint and floors the right child (and
+        mirrored for -1); categorical or unconstrained splits inherit."""
+        apply = (mc != 0) & (~icat)
+        up = mc > 0
+        l_lo = jnp.where(apply & (~up), jnp.maximum(lo_p, mid), lo_p)
+        l_hi = jnp.where(apply & up, jnp.minimum(hi_p, mid), hi_p)
+        r_lo = jnp.where(apply & up, jnp.maximum(lo_p, mid), lo_p)
+        r_hi = jnp.where(apply & (~up), jnp.minimum(hi_p, mid), hi_p)
+        return l_lo, l_hi, r_lo, r_hi
 
     def _root_eval(binned_view, vals, feature_mask, num_bin, na_bin,
                    is_cat, rng_iter):
@@ -271,10 +328,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             # space = feature_mask's axis, not binned_view's column count
             rb0 = _rand_bins(jax.random.fold_in(et_key, 0),
                              (feature_mask.shape[0],), num_bin)
+        kw = {"gain_scale": gscale, "rand_bin": rb0}
+        if use_mono:
+            kw.update(mono=mono_dev, out_lo=jnp.float32(-jnp.inf),
+                      out_hi=jnp.float32(jnp.inf))
+            if mono_penalty > 0.0:
+                kw["gain_scale"] = _mono_gain_scale(jnp.int32(0))
         res0 = select_fn(find_best_split(_expand(hist0, total0), total0,
                                          num_bin, na_bin, feature_mask,
-                                         params, root_out, is_cat,
-                                         gain_scale=gscale, rand_bin=rb0))
+                                         params, root_out, is_cat, **kw))
         return hist0, total0, root_out, res0, et_key
 
     def _init_state(n, nleaf, nnode, fv, hist0, total0, root_out,
@@ -286,6 +348,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             leaf_of_row=jnp.zeros(n, jnp.int32),
             hist=jnp.zeros((nleaf, fv, Bh, 3),
                            jnp.float32).at[0].set(hist0),
+            olo=jnp.full(nleaf, neg_inf),
+            ohi=jnp.full(nleaf, jnp.inf),
             bg=jnp.full(nleaf, neg_inf).at[0].set(res0.gain),
             bf=jnp.zeros(nleaf, jnp.int32).at[0].set(res0.feature),
             bt=jnp.zeros(nleaf, jnp.int32).at[0].set(res0.threshold),
@@ -399,6 +463,20 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 lcnt = st.leaf_count.at[leaf].set(lsum[2]).at[new_leaf].set(rsum[2])
                 ld = st.leaf_depth.at[leaf].set(d).at[new_leaf].set(d)
 
+                # --- monotone range propagation (basic) -------------------
+                lo2 = hi2 = depth2 = None
+                olo, ohi = st.olo, st.ohi
+                if use_mono:
+                    mid = 0.5 * (st.blo[leaf] + st.bro[leaf])
+                    l_lo, l_hi, r_lo, r_hi = _child_ranges(
+                        st.olo[leaf], st.ohi[leaf], mono_dev[feat], icat,
+                        mid)
+                    olo = st.olo.at[leaf].set(l_lo).at[new_leaf].set(r_lo)
+                    ohi = st.ohi.at[leaf].set(l_hi).at[new_leaf].set(r_hi)
+                    lo2 = jnp.stack([l_lo, r_lo])
+                    hi2 = jnp.stack([l_hi, r_hi])
+                    depth2 = jnp.stack([d, d])
+
                 # --- new best splits for both children (batched) ----------
                 hist2 = jnp.stack([hl_leaf, hl_new])
                 tot2 = jnp.stack([lsum, rsum])
@@ -408,13 +486,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     rand2 = _rand_bins(jax.random.fold_in(et_key, i + 1),
                                        (2, feature_mask.shape[0]), num_bin)
                 r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
-                            na_bin, feature_mask, po2, is_cat, rand2)
+                            na_bin, feature_mask, po2, is_cat, rand2,
+                            lo2, hi2, depth2)
                 depth_ok = (max_depth <= 0) | (d < max_depth)
                 g2 = jnp.where(depth_ok, r2.gain, -jnp.inf)
 
                 return st._replace(
                     leaf_of_row=leaf_of_row,
                     hist=hist,
+                    olo=olo, ohi=ohi,
                     bg=st.bg.at[leaf].set(g2[0]).at[new_leaf].set(g2[1]),
                     bf=st.bf.at[leaf].set(r2.feature[0]).at[new_leaf].set(r2.feature[1]),
                     bt=st.bt.at[leaf].set(r2.threshold[0]).at[new_leaf].set(r2.threshold[1]),
@@ -587,6 +667,22 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 ld = st.leaf_depth.at[leaf_sel].set(d_k) \
                                   .at[new_leaf_sel].set(d_k)
 
+                # --- monotone range propagation (basic, ×K) ---------------
+                lo2 = hi2 = depth2 = None
+                olo, ohi = st.olo, st.ohi
+                if use_mono:
+                    mid_k = 0.5 * (blo_k + bro_k)
+                    l_lo, l_hi, r_lo, r_hi = _child_ranges(
+                        st.olo[leaf_sel], st.ohi[leaf_sel],
+                        mono_dev[feat_k], icat_k, mid_k)
+                    olo = st.olo.at[leaf_sel].set(l_lo) \
+                                .at[new_leaf_sel].set(r_lo)
+                    ohi = st.ohi.at[leaf_sel].set(l_hi) \
+                                .at[new_leaf_sel].set(r_hi)
+                    lo2 = jnp.concatenate([l_lo, r_lo])
+                    hi2 = jnp.concatenate([l_hi, r_hi])
+                    depth2 = jnp.concatenate([d_k, d_k])
+
                 # --- best splits for all 2K children (batched) ------------
                 hist2 = jnp.concatenate([hl_leaf, hl_new])   # [2K, ...]
                 tot2 = jnp.concatenate([lsum_k, rsum_k])
@@ -597,7 +693,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                        (2 * K, feature_mask.shape[0]),
                                        num_bin)
                 r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
-                            na_bin, feature_mask, po2, is_cat, rand2)
+                            na_bin, feature_mask, po2, is_cat, rand2,
+                            lo2, hi2, depth2)
                 d2 = jnp.concatenate([d_k, d_k])
                 depth_ok = (max_depth <= 0) | (d2 < max_depth)
                 valid2 = jnp.concatenate([valid, valid])
@@ -620,6 +717,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 return st._replace(
                     leaf_of_row=leaf_of_row,
                     hist=hist,
+                    olo=olo, ohi=ohi,
                     bg=st.bg.at[idx2].set(g2),
                     bf=st.bf.at[idx2].set(r2.feature),
                     bt=st.bt.at[idx2].set(r2.threshold),

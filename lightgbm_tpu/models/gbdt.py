@@ -121,7 +121,18 @@ class GBDTModel:
             contri = fc[np.asarray(ds.used_features)]
         self._feature_contri = contri
         self._extra_trees = bool(config.extra_trees)
-        has_node_controls = (mono is not None and np.any(mono)) \
+        mono_active = mono is not None and np.any(mono)
+        # monotone 'basic' lives in the one-program masked grower too
+        # (device-resident [L] lo/hi range vectors, grower.py), so it no
+        # longer forces the host-orchestrated path and is supported under
+        # the data-parallel learner like the reference's parallel learners
+        # (monotone_constraints.hpp works under all of them).
+        # 'intermediate'/'advanced' recompute the whole frontier's
+        # intervals from sibling subtrees — still host bookkeeping.
+        mono_masked_ok = mono_active \
+            and config.monotone_constraints_method == "basic"
+        self._mono = mono if mono_active else None
+        has_node_controls = (mono_active and not mono_masked_ok) \
             or inter is not None or config.feature_fraction_bynode < 1.0 \
             or self._cegb_state is not None or self._forced_spec is not None
 
@@ -158,14 +169,20 @@ class GBDTModel:
                 dist = None             # single device -> serial (warned)
             elif has_node_controls:
                 raise ValueError(
-                    "monotone/interaction constraints, CEGB, forced splits "
-                    "and feature_fraction_bynode are not supported with "
+                    "monotone intermediate/advanced, interaction "
+                    "constraints, CEGB, forced splits and "
+                    "feature_fraction_bynode are not supported with "
                     f"tree_learner={dist} (they require the single-chip "
-                    "partitioned learner)")
+                    "partitioned learner); monotone basic IS supported")
             elif contri is not None or self._extra_trees:
                 raise ValueError(
                     "feature_contri and extra_trees are not yet supported "
                     f"with tree_learner={dist}")
+            elif mono_masked_ok and dist in ("feature", "voting"):
+                raise ValueError(
+                    f"monotone constraints with tree_learner={dist} are "
+                    "not supported (the [F] constraint vector would need "
+                    "feature-axis sharding); use tree_learner=data")
             else:
                 learner = "masked"
         else:
@@ -258,7 +275,9 @@ class GBDTModel:
                 num_bins=self.max_bin, params=self.split_params,
                 max_depth=config.max_depth, block_rows=config.rows_per_block,
                 efb=self.efb_dev if self._use_efb else None,
-                split_batch=self._split_batch)
+                split_batch=self._split_batch,
+                mono=self._mono if mono_masked_ok else None,
+                mono_penalty=config.monotone_penalty)
         elif dist == "voting":
             from ..parallel.voting_parallel import make_voting_grower
             self.grower = make_voting_grower(
@@ -295,10 +314,12 @@ class GBDTModel:
         else:
             if has_node_controls:
                 raise ValueError(
-                    "monotone/interaction constraints and "
+                    "monotone intermediate/advanced, interaction "
+                    "constraints, CEGB, forced splits and "
                     "feature_fraction_bynode currently require the "
                     "partitioned learner (tpu_learner=partitioned, "
-                    "single-chip)")
+                    "single-chip); monotone basic works on the masked "
+                    "learner")
             self.grower = make_grower(
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
@@ -306,7 +327,9 @@ class GBDTModel:
                 efb=self.efb_dev if self._use_efb else None,
                 gain_scale=contri, extra_trees=self._extra_trees,
                 extra_seed=config.extra_seed,
-                split_batch=self._split_batch)
+                split_batch=self._split_batch,
+                mono=self._mono if mono_masked_ok else None,
+                mono_penalty=config.monotone_penalty)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
             raise ValueError("linear_tree requires boosting=gbdt")
@@ -693,6 +716,8 @@ class GBDTModel:
                 gain_scale=self._feature_contri,
                 extra_trees=self._extra_trees, extra_seed=cfg.extra_seed,
                 split_batch=self._split_batch,
+                mono=self._mono if self._learner_kind == "masked" else None,
+                mono_penalty=cfg.monotone_penalty,
                 jit=False)
             obj = self.objective
             lr = jnp.float32(self.learning_rate)

@@ -413,19 +413,40 @@ class CrossEntropyLambda(ObjectiveFunction):
 # ranking (rank_objective.hpp:366)
 # ---------------------------------------------------------------------------
 
+_RANK_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
 def _pad_queries(boundaries: np.ndarray):
-    """Build [Q, maxq] row-index matrix + mask from query boundaries —
-    static-shape replacement for the per-query loops of
-    RankingObjective::GetGradients (rank_objective.hpp:40-60)."""
+    """Size-bucketed [Qb, mb] row-index/mask tensors from query boundaries
+    — static-shape replacement for the per-query loops of
+    RankingObjective::GetGradients (rank_objective.hpp:40-60).
+
+    Queries are grouped by padded size (powers of 4, then one overflow
+    bucket at the true max) so the pairwise [Qb, mb, mb] tensors track the
+    ACTUAL work: padding every query to the global max would blow up on
+    skewed query-size distributions (Yahoo LTR: thousands of ~20-doc
+    queries plus a handful of 1000+-doc ones would cost Q x maxq^2).
+
+    Returns a list of (query_ids [Qb], idx [Qb, mb], mask [Qb, mb], mb).
+    """
     sizes = np.diff(boundaries)
-    q, maxq = len(sizes), int(sizes.max())
-    idx = np.zeros((q, maxq), np.int32)
-    mask = np.zeros((q, maxq), np.float32)
-    for qi in range(q):
-        s = sizes[qi]
-        idx[qi, :s] = np.arange(boundaries[qi], boundaries[qi + 1])
-        mask[qi, :s] = 1.0
-    return jnp.asarray(idx), jnp.asarray(mask), int(maxq)
+    maxq = int(sizes.max())
+    caps = [c for c in _RANK_BUCKETS if c < maxq] + [maxq]
+    out = []
+    for bi, cap in enumerate(caps):
+        lo = 0 if bi == 0 else caps[bi - 1]
+        qids = np.nonzero((sizes > lo) & (sizes <= cap))[0]
+        if len(qids) == 0:
+            continue
+        idx = np.zeros((len(qids), cap), np.int32)
+        mask = np.zeros((len(qids), cap), np.float32)
+        for r, qi in enumerate(qids):
+            s = sizes[qi]
+            idx[r, :s] = np.arange(boundaries[qi], boundaries[qi + 1])
+            mask[r, :s] = 1.0
+        out.append((jnp.asarray(qids.astype(np.int32)), jnp.asarray(idx),
+                    jnp.asarray(mask), int(cap)))
+    return out
 
 
 class LambdarankNDCG(ObjectiveFunction):
@@ -441,7 +462,7 @@ class LambdarankNDCG(ObjectiveFunction):
         super().init(metadata, num_data)
         if metadata.query_boundaries is None:
             raise ValueError("lambdarank requires query/group information")
-        self.qidx, self.qmask, self.maxq = _pad_queries(metadata.query_boundaries)
+        self.buckets = _pad_queries(metadata.query_boundaries)
         lg = self.config.label_gain
         max_label = int(np.asarray(metadata.label).max())
         if lg is None:
@@ -462,11 +483,13 @@ class LambdarankNDCG(ObjectiveFunction):
             inv[qi] = 1.0 / dcg if dcg > 0 else 0.0
         self.inverse_max_dcg = jnp.asarray(inv)
 
-        self._grad_fn = jax.jit(self._gradients_impl)
+        # one jitted kernel reused across buckets: jax re-traces per
+        # distinct [Qb, mb] shape (a handful of compiles, bounded by
+        # len(_RANK_BUCKETS)+1), each sized to its bucket's real work
+        self._grad_fn = jax.jit(self._bucket_gradients)
 
-    def _gradients_impl(self, score):
-        qidx, qmask = self.qidx, self.qmask
-        s = score[qidx]                               # [Q, M]
+    def _bucket_gradients(self, score, qidx, qmask, inv_dcg):
+        s = score[qidx]                               # [Qb, M]
         y = self.label[qidx].astype(jnp.int32)
         neg = jnp.float32(-1e30)
         s_masked = jnp.where(qmask > 0, s, neg)
@@ -487,7 +510,7 @@ class LambdarankNDCG(ObjectiveFunction):
         pair_trunc = in_trunc[:, :, None] | in_trunc[:, None, :]
         valid &= higher & pair_trunc
 
-        delta = jnp.abs((gi - gj) * (di - dj)) * self.inverse_max_dcg[:, None, None]
+        delta = jnp.abs((gi - gj) * (di - dj)) * inv_dcg[:, None, None]
         if self.norm:
             # norm by |best - worst| proxy: reference normalizes lambdas by
             # sum; here scale deltas per query below
@@ -510,15 +533,25 @@ class LambdarankNDCG(ObjectiveFunction):
             hess_q = hess_q * scale[:, None]
             del cnt
 
-        # scatter back to row space
+        # scatter this bucket back to row space
         grad = jnp.zeros_like(score).at[qidx.reshape(-1)].add(
             (grad_q * qmask).reshape(-1))
         hess = jnp.zeros_like(score).at[qidx.reshape(-1)].add(
             (hess_q * qmask).reshape(-1))
-        return grad, jnp.maximum(hess, 1e-9)
+        return grad, hess
 
     def get_gradients(self, score):
-        return self._grad_fn(score)
+        if not hasattr(self, "_bucket_inv"):
+            self._bucket_inv = [self.inverse_max_dcg[qids]
+                                for qids, _, _, _ in self.buckets]
+        grad = jnp.zeros_like(score)
+        hess = jnp.zeros_like(score)
+        for (qids, qidx, qmask, _mb), inv in zip(self.buckets,
+                                                 self._bucket_inv):
+            g, h = self._grad_fn(score, qidx, qmask, inv)
+            grad = grad + g
+            hess = hess + h
+        return grad, jnp.maximum(hess, 1e-9)
 
 
 class RankXENDCG(ObjectiveFunction):
@@ -532,16 +565,19 @@ class RankXENDCG(ObjectiveFunction):
         super().init(metadata, num_data)
         if metadata.query_boundaries is None:
             raise ValueError("rank_xendcg requires query/group information")
-        self.qidx, self.qmask, self.maxq = _pad_queries(metadata.query_boundaries)
+        self.buckets = _pad_queries(metadata.query_boundaries)
         self._key = jax.random.PRNGKey(self.config.objective_seed)
         self._iter = 0
-        self._grad_fn = jax.jit(self._gradients_impl)
+        self._grad_fn = jax.jit(self._bucket_gradients)
 
-    def _gradients_impl(self, score, key):
-        qidx, qmask = self.qidx, self.qmask
+    def _bucket_gradients(self, score, key, qids, qidx, qmask):
         s = jnp.where(qmask > 0, score[qidx], -1e30)
         y = self.label[qidx]
-        gamma = jax.random.uniform(key, s.shape)
+        # per-QUERY gamma stream keyed by global query id, so the draw a
+        # query sees does not depend on how queries landed in buckets
+        keys = jax.vmap(lambda q: jax.random.fold_in(key, q))(qids)
+        gamma = jax.vmap(
+            lambda k: jax.random.uniform(k, (qmask.shape[1],)))(keys)
         phi = (jnp.exp2(y) - gamma) * qmask
         target = phi / jnp.maximum(phi.sum(axis=1, keepdims=True), 1e-9)
         rho = jax.nn.softmax(s, axis=1) * qmask
@@ -549,12 +585,18 @@ class RankXENDCG(ObjectiveFunction):
         hess_q = jnp.maximum(rho * (1.0 - rho), 1e-9) * qmask
         grad = jnp.zeros_like(score).at[qidx.reshape(-1)].add(grad_q.reshape(-1))
         hess = jnp.zeros_like(score).at[qidx.reshape(-1)].add(hess_q.reshape(-1))
-        return grad, jnp.maximum(hess, 1e-9)
+        return grad, hess
 
     def get_gradients(self, score):
         self._iter += 1
         key = jax.random.fold_in(self._key, self._iter)
-        return self._grad_fn(score, key)
+        grad = jnp.zeros_like(score)
+        hess = jnp.zeros_like(score)
+        for qids, qidx, qmask, _mb in self.buckets:
+            g, h = self._grad_fn(score, key, qids, qidx, qmask)
+            grad = grad + g
+            hess = hess + h
+        return grad, jnp.maximum(hess, 1e-9)
 
 
 # ---------------------------------------------------------------------------

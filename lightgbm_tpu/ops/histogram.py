@@ -55,21 +55,15 @@ def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
             rows outside the target leaf / bag must already be zeroed.
     returns [F, num_bins, C] float32.
 
-    Backend: the XLA one-hot-matmul scan below on every platform (fastest
-    measured on TPU v5e as well); LGBM_TPU_HIST=pallas selects the
-    experimental Pallas kernel (hist_pallas.py) instead.
+    Backend: the XLA one-hot-matmul scan below on every platform.  A
+    hand-written Pallas kernel was built and measured SLOWER on TPU v5e
+    (8.2 vs 4.7 ms/pass at 1M x 28 x 64 bins: XLA fuses the one-hot
+    generation into the dot's operand load better than the explicit
+    kernel, and the matmul already sits at the M-axis sublane ceiling
+    PROFILE.md documents), so it was removed rather than shipped as dead
+    code; the batched multi-leaf contraction (grower.py split_batch) is
+    the path past that ceiling.
     """
-    import os
-    mode = os.environ.get("LGBM_TPU_HIST", "auto")
-    # Default is the XLA one-hot matmul everywhere: measured on TPU v5e
-    # (1M x 28 x 64 bins, amortized in-graph) it runs 4.7 ms vs 8.2 ms for
-    # the best hand-written Pallas variant — XLA fuses the one-hot
-    # generation into the dot better than the explicit kernel.  The Pallas
-    # path is kept for experimentation via LGBM_TPU_HIST=pallas.
-    if mode == "pallas" and num_bins <= 4096:
-        from .hist_pallas import compute_histogram_pallas
-        return compute_histogram_pallas(binned, vals, num_bins=num_bins,
-                                        block_rows=block_rows)
     return _compute_histogram_matmul(binned, vals, num_bins=num_bins,
                                      block_rows=block_rows)
 

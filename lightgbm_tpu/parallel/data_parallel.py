@@ -47,7 +47,8 @@ def shard_rows(mesh: Mesh, arr, axis: str = "data"):
 def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    params: SplitParams, max_depth: int = -1,
                    block_rows: int = 0, axis: str = "data", efb=None,
-                   split_batch: int = 1):
+                   split_batch: int = 1, mono=None,
+                   mono_penalty: float = 0.0):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
     Inputs: binned [N, F] (or the bundled [N, G] group matrix when ``efb``
@@ -65,7 +66,8 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         max_depth=max_depth, block_rows=block_rows,
         hist_reduce=lambda h: lax.psum(h, axis),
         sum_reduce=lambda t: lax.psum(t, axis), efb=efb,
-        split_batch=split_batch, jit=False)
+        split_batch=split_batch, mono=mono, mono_penalty=mono_penalty,
+        jit=False)
 
     out_specs = TreeArrays(
         num_leaves=P(), split_feature=P(), threshold_bin=P(),

@@ -155,3 +155,88 @@ class TestMonotoneMethodSweep:
             loglib.Log.warning = orig
         assert any("advanced" in m and "intermediate" in m for m in msgs), \
             f"no loud fallback warning, got {msgs}"
+
+
+class TestMonotoneMasked:
+    """Monotone 'basic' on the one-program masked grower (device-resident
+    [L] lo/hi range vectors, grower.py) — the reference supports monotone
+    in ALL parallel learners (monotone_constraints.hpp), so the masked /
+    data-parallel paths must honor it too, not just the host-orchestrated
+    partitioned learner."""
+
+    P = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+         "min_data_in_leaf": 10, "monotone_constraints": [1, -1, 0],
+         "verbose": -1}
+
+    def test_masked_zero_violations(self):
+        x, y = _mono_data()
+        bst = lgb.train({**self.P, "tpu_learner": "masked"},
+                        lgb.Dataset(x, label=y), num_boost_round=30)
+        assert bst._model._learner_kind == "masked"
+        assert _check_monotone(bst, 0, +1)
+        assert _check_monotone(bst, 1, -1)
+
+    def test_masked_batched_zero_violations(self):
+        x, y = _mono_data()
+        bst = lgb.train({**self.P, "tpu_learner": "masked",
+                         "split_batch": 4},
+                        lgb.Dataset(x, label=y), num_boost_round=30)
+        assert _check_monotone(bst, 0, +1)
+        assert _check_monotone(bst, 1, -1)
+
+    def test_masked_fused_zero_violations(self):
+        x, y = _mono_data()
+        bst = lgb.train({**self.P, "tpu_learner": "masked",
+                         "fused_chunk": 10},
+                        lgb.Dataset(x, label=y), num_boost_round=30)
+        assert _check_monotone(bst, 0, +1)
+
+    def test_masked_matches_partitioned(self):
+        """Same 'basic' semantics on both learners -> identical trees."""
+        x, y = _mono_data()
+        b_m = lgb.train({**self.P, "tpu_learner": "masked"},
+                        lgb.Dataset(x, label=y), num_boost_round=10)
+        b_p = lgb.train({**self.P, "tpu_learner": "partitioned"},
+                        lgb.Dataset(x, label=y), num_boost_round=10)
+        assert len(b_m.trees) == len(b_p.trees)
+        for tm, tp in zip(b_m.trees, b_p.trees):
+            np.testing.assert_array_equal(tm.split_feature, tp.split_feature)
+            np.testing.assert_allclose(tm.leaf_value, tp.leaf_value,
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_masked_penalty(self):
+        x, y = _mono_data()
+        bst = lgb.train({**self.P, "tpu_learner": "masked",
+                         "monotone_penalty": 2.0},
+                        lgb.Dataset(x, label=y), num_boost_round=20)
+        assert _check_monotone(bst, 0, +1)
+
+    @pytest.mark.skipif(
+        __import__("jax").device_count() < 8,
+        reason="needs the 8-device virtual mesh")
+    def test_data_parallel_monotone(self):
+        x, y = _mono_data()
+        b_s = lgb.train({**self.P, "tpu_learner": "masked"},
+                        lgb.Dataset(x, label=y), num_boost_round=10)
+        b_d = lgb.train({**self.P, "tree_learner": "data"},
+                        lgb.Dataset(x, label=y), num_boost_round=10)
+        assert b_d._model._dist == "data"
+        assert _check_monotone(b_d, 0, +1)
+        assert _check_monotone(b_d, 1, -1)
+        for tm, tp in zip(b_s.trees, b_d.trees):
+            np.testing.assert_array_equal(tm.split_feature, tp.split_feature)
+
+    def test_feature_parallel_monotone_refused(self):
+        x, y = _mono_data()
+        with pytest.raises(ValueError, match="tree_learner=feature"):
+            lgb.train({**self.P, "tree_learner": "feature"},
+                      lgb.Dataset(x, label=y), num_boost_round=2)
+
+    def test_intermediate_still_partitioned(self):
+        """Non-basic methods keep the host-orchestrated learner."""
+        x, y = _mono_data()
+        bst = lgb.train({**self.P,
+                         "monotone_constraints_method": "intermediate"},
+                        lgb.Dataset(x, label=y), num_boost_round=5)
+        assert bst._model._learner_kind == "partitioned"
+        assert _check_monotone(bst, 0, +1)

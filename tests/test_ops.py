@@ -185,21 +185,3 @@ class TestGrower:
         assert int(np.array(tree.leaf_depth)[:int(tree.num_leaves)].max()) <= 2
 
 
-class TestPallasHistogram:
-    """Pallas TPU kernel (interpret mode on CPU) vs the XLA matmul path."""
-
-    def test_matches_matmul(self):
-        from lightgbm_tpu.ops.histogram import _compute_histogram_matmul
-        from lightgbm_tpu.ops.hist_pallas import compute_histogram_pallas
-        rng = np.random.RandomState(7)
-        for n, f, b in [(1000, 28, 63), (257, 5, 10), (64, 200, 16),
-                        (500, 3, 256)]:
-            binned = rng.randint(0, b, size=(n, f)).astype(
-                np.uint8 if b <= 256 else np.int32)
-            vals = rng.randn(n, 3).astype(np.float32)
-            ref = np.asarray(_compute_histogram_matmul(
-                jnp.asarray(binned), jnp.asarray(vals), num_bins=b))
-            got = np.asarray(compute_histogram_pallas(
-                jnp.asarray(binned), jnp.asarray(vals), num_bins=b,
-                interpret=True))
-            np.testing.assert_allclose(got, ref, atol=2e-3, rtol=1e-5)
