@@ -54,15 +54,19 @@ def make_higgs_like(n: int, f: int, seed: int = 0):
     return x, y
 
 
-def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None):
+def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
+                 split_batch=0):
     """Train one config; returns (ips, auc, ds) steady-state over n_chunks
     fused chunks (or per-iter updates when fusion is unavailable).  Pass
     ``ds`` to reuse an already-binned dataset (num_leaves is a Booster
-    param; binning is identical across points on the same data)."""
+    param; binning is identical across points on the same data).
+    split_batch: 0 = config auto (strict below 64 leaves, 8-way above),
+    explicit K pins the grower's super-step width (grower.py)."""
     params = {
         "objective": "binary", "num_leaves": num_leaves,
         "learning_rate": 0.1, "max_bin": PRIMARY_MAX_BIN,
         "min_data_in_leaf": 20, "verbosity": 0,
+        "split_batch": split_batch,
     }
     t0 = time.time()
     if ds is None:
@@ -115,6 +119,13 @@ def child() -> None:
           flush=True)
     t_dev = time.time()
     import jax
+    if os.environ.get("_BENCH_CPU") == "1":
+        # in-process override, NOT the JAX_PLATFORMS env var: the axon
+        # sitecustomize pins the platform config at interpreter start, so
+        # the env var is ignored and jax.devices() would still try to
+        # claim the (possibly wedged) TPU tunnel; jax.config.update is
+        # the supported escape (same pattern as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     devs = jax.devices()
     print(f"[bench] devices={devs} ({time.time() - t_dev:.1f}s)",
           file=sys.stderr, flush=True)
@@ -122,16 +133,19 @@ def child() -> None:
 
     x, y = make_higgs_like(N_ROWS, N_FEAT)
 
-    # primary: 1M x 28, 31 leaves (round-over-round comparable)
+    # primary: 1M x 28, 31 leaves, 8-way batched super-steps (the
+    # framework's fast growth mode; AUC reported alongside so quality is
+    # auditable against the strict point below)
     ips1, auc1, ds1 = _train_point(lgb, x, y, num_leaves=PRIMARY_LEAVES,
                                    chunk=4 if quick else 25,
                                    n_chunks=1 if quick else 4,
-                                   tag="1M/31leaf")
+                                   tag="1M/31leaf/sb8", split_batch=8)
 
     rec = {
         "metric": METRIC,
         "value": round(ips1, 3),
-        "unit": "iters/s (1M rows x 28 feat, 31 leaves, 63 bins)",
+        "unit": ("iters/s (1M rows x 28 feat, 31 leaves, 63 bins, "
+                 "split_batch=8)"),
         "vs_baseline": round(ips1 / BASELINE_IPS, 3),
     }
     # emit the primary record NOW: if an extra point wedges and the parent
@@ -140,8 +154,20 @@ def child() -> None:
     # supersedes this one)
     print(json.dumps(rec), flush=True)
 
-    extra = {}
+    extra = {"higgs1m_31leaf_sb8_auc": round(float(auc1), 4)}
     if not quick:
+        # strict leaf-wise growth (split_batch=1): round-over-round
+        # comparable with BENCH_r02/r03 history + the AUC quality anchor
+        try:
+            ips0, auc0, _ = _train_point(lgb, x, y,
+                                         num_leaves=PRIMARY_LEAVES,
+                                         chunk=25, n_chunks=2,
+                                         tag="1M/31leaf/strict", ds=ds1,
+                                         split_batch=1)
+            extra["higgs1m_31leaf_strict_iters_per_sec"] = round(ips0, 3)
+            extra["higgs1m_31leaf_strict_auc"] = round(float(auc0), 4)
+        except Exception as e:
+            extra["higgs1m_strict_error"] = f"{type(e).__name__}: {e}"[:200]
         # VERDICT r2 task 3a: the baseline's 255-leaf shape (at 1M rows)
         try:
             ips2, auc2, _ = _train_point(lgb, x, y, num_leaves=255, chunk=4,
@@ -162,7 +188,8 @@ def child() -> None:
             y10 = np.concatenate([y] * 10)
             ips3, auc3, _ = _train_point(lgb, x10, y10, num_leaves=31,
                                          chunk=8, n_chunks=2,
-                                         tag="10M/31leaf")
+                                         tag="10M/31leaf/sb8",
+                                         split_batch=8)
             extra["higgs10m_iters_per_sec"] = round(ips3, 3)
             extra["higgs10m_auc"] = round(float(auc3), 4)
         except Exception as e:
@@ -248,7 +275,7 @@ def main():
               flush=True)
 
     # last resort: reduced CPU run — an honest degraded number beats none
-    line, err = run_child({"JAX_PLATFORMS": "cpu", "_BENCH_QUICK": "1"},
+    line, err = run_child({"_BENCH_CPU": "1", "_BENCH_QUICK": "1"},
                           timeout=600)
     if line:
         rec = json.loads(line)

@@ -27,10 +27,12 @@ from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .ops.histogram import compute_histogram
-from .ops.split import SplitParams, SplitResult, find_best_split, leaf_output
+from .ops.split import (SplitParams, SplitResult, find_best_split,
+                        leaf_output, monotone_penalty_factor)
 
 
 class TreeArrays(NamedTuple):
@@ -64,6 +66,10 @@ class _GrowState(NamedTuple):
     # per-leaf allowed output range (monotone 'basic' method; ±inf w/o)
     olo: jax.Array               # [L] f32
     ohi: jax.Array               # [L] f32
+    # per-leaf allowed features (interaction constraints; [1,1] w/o)
+    fallow: jax.Array            # [L, F] bool (or [L, 1] placeholder)
+    # features already split on (CEGB coupled penalties; [1] w/o)
+    cuse: jax.Array              # [F] bool (or [1] placeholder)
     # per-leaf best-split candidates
     bg: jax.Array                # [L] gain
     bf: jax.Array                # [L] feature
@@ -110,6 +116,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 extra_trees: bool = False, extra_seed: int = 6,
                 split_batch: int = 1,
                 mono=None, mono_penalty: float = 0.0,
+                interaction_allow=None,
+                bynode_frac: float = 1.0, bynode_seed: int = 0,
+                cegb=None,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
@@ -146,6 +155,19 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       totals reconstructing the shared default bin (FixHistogram,
       dataset.cpp:1292).  Row partitioning decodes the winning feature's
       bins from its group column.
+    - interaction_allow: [F, F] bool allowed-interaction matrix
+      (ColSampler / col_sampler.hpp interaction constraints): per-leaf
+      allowed-feature masks are tracked on device ([L, F] state); a split
+      on feature f restricts both children to ``parent_mask & allow[f]``.
+    - bynode_frac/bynode_seed: feature_fraction_bynode — every candidate
+      leaf evaluation draws its own random feature subset in-graph
+      (keyed by iteration/step/child so the fused scan reproduces the
+      per-iteration stream).
+    - cegb: a ``CEGBState`` (cost_effective_gradient_boosting.hpp):
+      per-candidate acquisition penalties subtracted from gains in-graph;
+      within-tree feature usage is tracked as an [F] bool state vector,
+      and cross-tree usage comes in through ``grow(..., cegb_used=...)``
+      (the caller derives the update from the returned split features).
     - mono/mono_penalty: [F] -1/0/+1 monotone constraints, 'basic' method
       (monotone_constraints.hpp BasicLeafConstraints): per-leaf allowed
       output ranges tracked ON DEVICE ([L] lo/hi vectors in the grow
@@ -233,6 +255,43 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                                          jnp.float32)
     mono_dev = None if mono is None else jnp.asarray(mono, jnp.int32)
     use_mono = mono_dev is not None
+    inter_dev = None if interaction_allow is None \
+        else jnp.asarray(interaction_allow, bool)
+    use_inter = inter_dev is not None
+    use_bynode = 0.0 < float(bynode_frac) < 1.0
+    use_cegb = cegb is not None and cegb.active
+    if use_cegb:
+        nf_c = len(cegb.used)
+        lazy = cegb.lazy if cegb.lazy is not None else np.zeros(nf_c)
+        # per-count slope and coupled once-per-model components of
+        # CEGBState.penalty_vector, as device constants
+        cegb_slope = jnp.asarray(
+            cegb.tradeoff * (cegb.penalty_split + lazy), jnp.float32)
+        cegb_coupled = None if cegb.coupled is None else \
+            jnp.asarray(cegb.tradeoff * cegb.coupled, jnp.float32)
+
+    def _cegb_penalty(count, cuse):
+        pen = cegb_slope * count
+        if cegb_coupled is not None:
+            pen = pen + cegb_coupled * (~cuse)
+        return pen
+    # per-leaf feature masks are threaded through _best2 whenever EITHER
+    # mechanism is active (they compose by &)
+    per_leaf_mask = use_inter or use_bynode
+
+    def _bynode_mask(key, base):
+        """One random feature subset (ColSampler bynode): keep
+        ceil(frac * |valid|) features sampled FROM the valid set ``base``
+        (reference semantics, col_sampler.hpp — sampling from the full
+        axis and intersecting could leave a constrained branch with an
+        empty candidate set).  Always keeps >= 1 valid feature."""
+        nf = base.shape[0]
+        nvalid = base.sum()
+        k = jnp.maximum(1, jnp.ceil(
+            nvalid.astype(jnp.float32) * bynode_frac)).astype(jnp.int32)
+        u = jnp.where(base, jax.random.uniform(key, (nf,)), jnp.inf)
+        rank = jnp.argsort(jnp.argsort(u))
+        return base & (rank < k)
 
     def _rand_bins(key, shape, num_bin):
         """extra_trees (feature_histogram.hpp:116): one random threshold
@@ -242,22 +301,19 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         return jnp.minimum((u * span).astype(jnp.int32), num_bin - 2)
 
     def _mono_gain_scale(depth):
-        """Depth-based penalty factor on monotone features
-        (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355);
-        returns a per-feature [F] scale, composed with ``gain_scale``."""
-        pen = float(mono_penalty)
-        d = depth.astype(jnp.float32)
-        factor = jnp.where(
-            pen >= d + 1.0, 1e-15,
-            jnp.where(pen <= 1.0, 1.0 - pen / (2.0 ** d) + 1e-15,
-                      1.0 - 2.0 ** (pen - 1.0 - d) + 1e-15))
+        """Per-feature [F] penalty scale on monotone features, composed
+        with ``gain_scale`` (shared formula: ops/split.py
+        monotone_penalty_factor)."""
+        factor = monotone_penalty_factor(mono_penalty, depth)
         gs = jnp.where(mono_dev != 0, factor, 1.0).astype(jnp.float32)
         return gs if gscale is None else gs * gscale
 
     def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2, is_cat,
-               rand2=None, lo2=None, hi2=None, depth2=None):
+               rand2=None, lo2=None, hi2=None, depth2=None, fmask2=None,
+               cuse_cur=None):
         """Vmapped best-split over a batch of candidate leaves; optional
-        per-leaf extra_trees random bins and monotone output ranges."""
+        per-leaf extra_trees random bins, monotone output ranges, and
+        per-leaf feature masks (interaction constraints / bynode)."""
         extras, axes = [], []
         if rand2 is not None:
             extras.append(rand2)
@@ -265,6 +321,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         if use_mono:
             extras += [lo2, hi2, depth2]
             axes += [0, 0, 0]
+        if fmask2 is not None:
+            extras.append(fmask2)
+            axes.append(0)
 
         def one(h, t, po, *rest):
             i = 0
@@ -274,12 +333,19 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 i += 1
             if use_mono:
                 lo, hi, d = rest[i], rest[i + 1], rest[i + 2]
+                i += 3
                 kw.update(mono=mono_dev, out_lo=lo, out_hi=hi)
                 kw["gain_scale"] = _mono_gain_scale(d) \
                     if mono_penalty > 0.0 else gscale
             else:
                 kw["gain_scale"] = gscale
-            return select_fn(find_best_split(h, t, num_bin, na_bin, fmask,
+            fm = rest[i] if fmask2 is not None else fmask
+            if use_cegb:
+                # cuse_cur is shared by all children of this step (the
+                # vmap closes over it); the penalty's count term is the
+                # candidate leaf's own row count
+                kw["gain_penalty"] = _cegb_penalty(t[2], cuse_cur)
+            return select_fn(find_best_split(h, t, num_bin, na_bin, fm,
                                              params, po, is_cat, **kw))
 
         return jax.vmap(one, in_axes=(0, 0, 0) + tuple(axes))(
@@ -298,7 +364,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         return l_lo, l_hi, r_lo, r_hi
 
     def _root_eval(binned_view, vals, feature_mask, num_bin, na_bin,
-                   is_cat, rng_iter):
+                   is_cat, rng_iter, cuse0=None):
         """Root histogram + aggregates + best split; shared by the strict
         and batched growers."""
         hist0 = _hist(binned_view, vals)            # [F|G, B|Bg, 3]
@@ -328,19 +394,29 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             # space = feature_mask's axis, not binned_view's column count
             rb0 = _rand_bins(jax.random.fold_in(et_key, 0),
                              (feature_mask.shape[0],), num_bin)
+        bn_key = None
+        fmask_root = feature_mask
+        if use_bynode:
+            bn_key = jax.random.PRNGKey(bynode_seed)
+            if rng_iter is not None:
+                bn_key = jax.random.fold_in(bn_key, rng_iter)
+            fmask_root = _bynode_mask(jax.random.fold_in(bn_key, 0),
+                                      feature_mask)
         kw = {"gain_scale": gscale, "rand_bin": rb0}
         if use_mono:
             kw.update(mono=mono_dev, out_lo=jnp.float32(-jnp.inf),
                       out_hi=jnp.float32(jnp.inf))
             if mono_penalty > 0.0:
                 kw["gain_scale"] = _mono_gain_scale(jnp.int32(0))
+        if use_cegb:
+            kw["gain_penalty"] = _cegb_penalty(total0[2], cuse0)
         res0 = select_fn(find_best_split(_expand(hist0, total0), total0,
-                                         num_bin, na_bin, feature_mask,
+                                         num_bin, na_bin, fmask_root,
                                          params, root_out, is_cat, **kw))
-        return hist0, total0, root_out, res0, et_key
+        return hist0, total0, root_out, res0, et_key, bn_key
 
-    def _init_state(n, nleaf, nnode, fv, hist0, total0, root_out,
-                    res0) -> _GrowState:
+    def _init_state(n, nleaf, nnode, fv, nf, hist0, total0, root_out,
+                    res0, cuse0=None) -> _GrowState:
         """Fresh grow state with ``nleaf`` leaf slots / ``nnode`` node
         slots (== L/L-1 strict; +K scratch slots batched)."""
         neg_inf = jnp.float32(-jnp.inf)
@@ -350,6 +426,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                            jnp.float32).at[0].set(hist0),
             olo=jnp.full(nleaf, neg_inf),
             ohi=jnp.full(nleaf, jnp.inf),
+            fallow=jnp.ones((nleaf, nf if use_inter else 1), bool),
+            cuse=cuse0 if cuse0 is not None else jnp.zeros(1, bool),
             bg=jnp.full(nleaf, neg_inf).at[0].set(res0.gain),
             bf=jnp.zeros(nleaf, jnp.int32).at[0].set(res0.feature),
             bt=jnp.zeros(nleaf, jnp.int32).at[0].set(res0.threshold),
@@ -383,19 +461,24 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
     def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
                   na_bin_part=None, is_cat=None,
-                  rng_iter=None) -> TreeArrays:
+                  rng_iter=None, cegb_used=None) -> TreeArrays:
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
         f = binned_view.shape[1]
         child_hist = _make_child_hist(n)
         if na_bin_part is None:
             na_bin_part = na_bin
+        cuse0 = None
+        if use_cegb:
+            cuse0 = cegb_used if cegb_used is not None \
+                else jnp.zeros(feature_mask.shape[0], bool)
 
-        hist0, total0, root_out, res0, et_key = _root_eval(
+        hist0, total0, root_out, res0, et_key, bn_key = _root_eval(
             binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
-            rng_iter)
-        st = _init_state(n, L, L - 1, binned_view.shape[1], hist0, total0,
-                         root_out, res0)
+            rng_iter, cuse0)
+        st = _init_state(n, L, L - 1, binned_view.shape[1],
+                         feature_mask.shape[0], hist0, total0,
+                         root_out, res0, cuse0)
 
         def split_step(i, st: _GrowState) -> _GrowState:
             leaf = jnp.argmax(st.bg).astype(jnp.int32)
@@ -477,6 +560,27 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     hi2 = jnp.stack([l_hi, r_hi])
                     depth2 = jnp.stack([d, d])
 
+                # --- per-leaf feature masks (interaction / bynode) --------
+                fmask2 = None
+                fallow = st.fallow
+                if per_leaf_mask:
+                    nf = feature_mask.shape[0]
+                    if use_inter:
+                        child_allow = st.fallow[leaf] & inter_dev[feat]
+                        fallow = st.fallow.at[leaf].set(child_allow) \
+                                          .at[new_leaf].set(child_allow)
+                        base = child_allow & feature_mask
+                    else:
+                        base = feature_mask
+                    if use_bynode:
+                        kL = jax.random.fold_in(bn_key, 2 * (i + 1))
+                        kR = jax.random.fold_in(bn_key, 2 * (i + 1) + 1)
+                        m_l = _bynode_mask(kL, base)
+                        m_r = _bynode_mask(kR, base)
+                    else:
+                        m_l = m_r = base
+                    fmask2 = jnp.stack([m_l, m_r])
+
                 # --- new best splits for both children (batched) ----------
                 hist2 = jnp.stack([hl_leaf, hl_new])
                 tot2 = jnp.stack([lsum, rsum])
@@ -485,16 +589,21 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 if extra_trees:
                     rand2 = _rand_bins(jax.random.fold_in(et_key, i + 1),
                                        (2, feature_mask.shape[0]), num_bin)
+                cuse = st.cuse
+                if use_cegb:
+                    cuse = st.cuse | (
+                        jnp.arange(st.cuse.shape[0], dtype=jnp.int32)
+                        == feat)
                 r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
                             na_bin, feature_mask, po2, is_cat, rand2,
-                            lo2, hi2, depth2)
+                            lo2, hi2, depth2, fmask2, cuse)
                 depth_ok = (max_depth <= 0) | (d < max_depth)
                 g2 = jnp.where(depth_ok, r2.gain, -jnp.inf)
 
                 return st._replace(
                     leaf_of_row=leaf_of_row,
                     hist=hist,
-                    olo=olo, ohi=ohi,
+                    olo=olo, ohi=ohi, fallow=fallow, cuse=cuse,
                     bg=st.bg.at[leaf].set(g2[0]).at[new_leaf].set(g2[1]),
                     bf=st.bf.at[leaf].set(r2.feature[0]).at[new_leaf].set(r2.feature[1]),
                     bt=st.bt.at[leaf].set(r2.threshold[0]).at[new_leaf].set(r2.threshold[1]),
@@ -551,7 +660,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
     def grow_tree_batched(binned, vals, feature_mask, num_bin, na_bin,
                           na_bin_part=None, is_cat=None,
-                          rng_iter=None) -> TreeArrays:
+                          rng_iter=None, cegb_used=None) -> TreeArrays:
         """K-splits-per-super-step grower (split_batch above).
 
         Per-leaf state arrays carry K scratch slots past the real range
@@ -565,11 +674,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         if na_bin_part is None:
             na_bin_part = na_bin
         LP, NP = L + K, (L - 1) + K
+        cuse0 = None
+        if use_cegb:
+            cuse0 = cegb_used if cegb_used is not None \
+                else jnp.zeros(feature_mask.shape[0], bool)
 
-        hist0, total0, root_out, res0, et_key = _root_eval(
+        hist0, total0, root_out, res0, et_key, bn_key = _root_eval(
             binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
-            rng_iter)
-        st = _init_state(n, LP, NP, fv, hist0, total0, root_out, res0)
+            rng_iter, cuse0)
+        st = _init_state(n, LP, NP, fv, feature_mask.shape[0], hist0,
+                         total0, root_out, res0, cuse0)
 
         neg_inf = jnp.float32(-jnp.inf)
         kidx = jnp.arange(K, dtype=jnp.int32)
@@ -683,6 +797,30 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     hi2 = jnp.concatenate([l_hi, r_hi])
                     depth2 = jnp.concatenate([d_k, d_k])
 
+                # --- per-leaf feature masks (interaction / bynode, ×K) ----
+                fmask2 = None
+                fallow = st.fallow
+                if per_leaf_mask:
+                    nf = feature_mask.shape[0]
+                    if use_inter:
+                        child_allow = st.fallow[leaf_sel] \
+                            & inter_dev[feat_k]              # [K, F]
+                        fallow = st.fallow.at[leaf_sel].set(child_allow) \
+                                          .at[new_leaf_sel].set(child_allow)
+                        base = child_allow & feature_mask[None]
+                    else:
+                        base = jnp.broadcast_to(feature_mask[None],
+                                                (K, nf))
+                    if use_bynode:
+                        ids = (s + 1) * 2 * K \
+                            + jnp.arange(2 * K, dtype=jnp.int32)
+                        keys = jax.vmap(
+                            lambda j: jax.random.fold_in(bn_key, j))(ids)
+                        fmask2 = jax.vmap(_bynode_mask)(
+                            keys, jnp.concatenate([base, base]))
+                    else:
+                        fmask2 = jnp.concatenate([base, base])
+
                 # --- best splits for all 2K children (batched) ------------
                 hist2 = jnp.concatenate([hl_leaf, hl_new])   # [2K, ...]
                 tot2 = jnp.concatenate([lsum_k, rsum_k])
@@ -692,9 +830,14 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     rand2 = _rand_bins(jax.random.fold_in(et_key, s + 1),
                                        (2 * K, feature_mask.shape[0]),
                                        num_bin)
+                cuse = st.cuse
+                if use_cegb:
+                    marks = jnp.zeros(st.cuse.shape[0], jnp.int32) \
+                        .at[feat_k].add(valid.astype(jnp.int32))
+                    cuse = st.cuse | (marks > 0)
                 r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
                             na_bin, feature_mask, po2, is_cat, rand2,
-                            lo2, hi2, depth2)
+                            lo2, hi2, depth2, fmask2, cuse)
                 d2 = jnp.concatenate([d_k, d_k])
                 depth_ok = (max_depth <= 0) | (d2 < max_depth)
                 valid2 = jnp.concatenate([valid, valid])
@@ -717,7 +860,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 return st._replace(
                     leaf_of_row=leaf_of_row,
                     hist=hist,
-                    olo=olo, ohi=ohi,
+                    olo=olo, ohi=ohi, fallow=fallow, cuse=cuse,
                     bg=st.bg.at[idx2].set(g2),
                     bf=st.bf.at[idx2].set(r2.feature),
                     bt=st.bt.at[idx2].set(r2.threshold),

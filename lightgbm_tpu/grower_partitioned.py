@@ -31,7 +31,8 @@ from jax import lax
 
 from .grower import TreeArrays
 from .ops.histogram import compute_histogram
-from .ops.split import SplitParams, SplitResult, find_best_split, leaf_output
+from .ops.split import (SplitParams, SplitResult, find_best_split,
+                        leaf_output, monotone_penalty_factor)
 
 
 def _pow2(x: int) -> int:
@@ -188,19 +189,15 @@ class PartitionedGrower:
         # 'basic' = midpoint range splitting (BasicLeafConstraints);
         # 'intermediate' = constraints from actual opposite-subtree
         # outputs, refreshed across the whole frontier after each split
-        # (IntermediateLeafConstraints, monotone_constraints.hpp:514).
-        # 'advanced' (AdvancedLeafConstraints, monotone_constraints.hpp:856
-        # — per-threshold cumulative constraint refinement) is not
-        # implemented; it falls back to 'intermediate', which is strictly
-        # MORE conservative: every model it produces satisfies the
-        # constraints, it just forfeits some gain the advanced method
-        # could have recovered.  The fallback is loud, not silent.
-        if mono_method == "advanced" and self.mono is not None:
-            from .utils.log import Log
-            Log.warning(
-                "monotone_constraints_method=advanced is not implemented; "
-                "falling back to 'intermediate' (more conservative — "
-                "constraints still fully enforced)")
+        # (IntermediateLeafConstraints, monotone_constraints.hpp:514);
+        # 'advanced' = per-THRESHOLD constraint refinement
+        # (AdvancedLeafConstraints, monotone_constraints.hpp:856): a
+        # candidate split is only constrained by leaves whose region
+        # actually overlaps the resulting child's region.  Implemented
+        # from leaf bounding boxes (_leaf_boxes/_advanced_bounds): exact
+        # per-(feature, bin) neighbor bounds rather than the reference's
+        # incremental up-walk bookkeeping — at least as tight, and
+        # recomputed per frontier refresh like the intermediate mode.
         self.mono_method = mono_method
         self.mono_penalty = float(mono_penalty)
         self.interaction_allow = interaction_allow
@@ -253,6 +250,16 @@ class PartitionedGrower:
         inf = np.float32(np.finfo(np.float32).max)
         leaf_lo = {0: -inf}
         leaf_hi = {0: inf}
+        use_advanced = self.mono is not None \
+            and self.mono_method == "advanced"
+        adv_bounds: dict = {}
+        adv_prev_boxes: list = [None]
+        if use_advanced:
+            nf_adv = len(np.asarray(num_bin))
+            adv_bounds[0] = (np.full((nf_adv, B), -np.inf, np.float32),
+                             np.full((nf_adv, B), np.inf, np.float32),
+                             np.full((nf_adv, B), -np.inf, np.float32),
+                             np.full((nf_adv, B), np.inf, np.float32))
 
         def _node_mask(mask: np.ndarray) -> jax.Array:
             if self.bynode_frac < 1.0:
@@ -272,17 +279,15 @@ class PartitionedGrower:
                 kw = dict(mono=self.mono,
                           out_lo=jnp.float32(leaf_lo[leaf]),
                           out_hi=jnp.float32(leaf_hi[leaf]))
+                if use_advanced:
+                    kw["mono_bounds"] = tuple(
+                        jnp.asarray(a) for a in adv_bounds[leaf])
                 if self.mono_penalty > 0.0:
-                    d = depth.get(leaf, 0)
-                    pen = self.mono_penalty
-                    if pen >= d + 1.0:
-                        factor = 1e-15
-                    elif pen <= 1.0:
-                        factor = 1.0 - pen / (2.0 ** d) + 1e-15
-                    else:
-                        factor = 1.0 - 2.0 ** (pen - 1.0 - d) + 1e-15
+                    factor = monotone_penalty_factor(self.mono_penalty,
+                                                     depth.get(leaf, 0))
                     kw["gain_scale"] = jnp.where(
-                        self.mono != 0, jnp.float32(factor), jnp.float32(1.0))
+                        self.mono != 0, factor.astype(jnp.float32),
+                        jnp.float32(1.0))
             if cegb_state is not None and cegb_state.active:
                 kw["gain_penalty"] = jnp.asarray(
                     cegb_state.penalty_vector(total[2]))
@@ -465,10 +470,57 @@ class PartitionedGrower:
             lo_p, hi_p = leaf_lo[leaf], leaf_hi[leaf]
             mc = 0 if self.mono is None else int(np.asarray(self.mono)[rec.feature])
             use_intermediate = (self.mono is not None
-                                and self.mono_method in ("intermediate",
-                                                         "advanced"))
+                                and self.mono_method == "intermediate")
             refresh = []
-            if use_intermediate:
+            if use_advanced:
+                # recompute per-threshold bounds ONLY for leaves this
+                # split can affect: a leaf's bounds depend on boxes and
+                # outputs of its monotone neighbors, and the only changed
+                # regions are the split leaf's old box and the two child
+                # boxes — any other leaf keeps its cached bounds (the
+                # AdvancedLeafConstraints GoUpToFindLeavesToUpdate role,
+                # as a box-overlap filter instead of a tree up-walk)
+                num_leaves_next = new + 1
+                boxes = self._leaf_boxes(
+                    num_leaves_next, split_feature, threshold_bin,
+                    left_child, right_child, is_cat_node,
+                    np.asarray(num_bin))
+                mono_np = np.asarray(self.mono)
+                mono_feats = np.nonzero(mono_np != 0)[0]
+                nf_b = boxes.shape[1]
+                cand_boxes = [boxes[leaf], boxes[new]]
+                if adv_prev_boxes[0] is not None \
+                        and leaf < len(adv_prev_boxes[0]):
+                    cand_boxes.append(adv_prev_boxes[0][leaf])
+
+                def _could_constrain(l):
+                    for cb in cand_boxes:
+                        ov = (cb[:, 0] <= boxes[l, :, 1]) \
+                            & (boxes[l, :, 0] <= cb[:, 1])
+                        for f in mono_feats:
+                            if ov.sum() >= nf_b - (0 if ov[f] else 1):
+                                if np.all(ov | (np.arange(nf_b) == f)):
+                                    return True
+                    return False
+
+                for l in range(num_leaves_next):
+                    if l in (leaf, new) or l not in adv_bounds \
+                            or _could_constrain(l):
+                        nbnd = self._advanced_bounds(boxes, leaf_value, l,
+                                                     B)
+                        old = adv_bounds.get(l)
+                        if l not in (leaf, new) and (
+                                old is None or any(
+                                    not np.array_equal(a, b)
+                                    for a, b in zip(old, nbnd))):
+                            refresh.append(l)
+                        adv_bounds[l] = nbnd
+                    # scalar range is unused under advanced (the per-bin
+                    # bounds replace it) but must exist for _find_leaf
+                    leaf_lo.setdefault(l, -inf)
+                    leaf_hi.setdefault(l, inf)
+                adv_prev_boxes[0] = boxes
+            elif use_intermediate:
                 # recompute the whole frontier's intervals from the actual
                 # opposite-subtree outputs (IntermediateLeafConstraints
                 # UpdateConstraintsWithOutputs + GoUpToFindLeavesToUpdate,
@@ -571,6 +623,118 @@ class PartitionedGrower:
             is_cat_node=jnp.asarray(is_cat_node),
             cat_rank=jnp.asarray(cat_rank),
         )
+
+    @staticmethod
+    def _leaf_boxes(num_leaves, split_feature, threshold_bin, left_child,
+                    right_child, is_cat_node, nb_host):
+        """[M, F, 2] inclusive bin-range bounding box per current leaf,
+        from the numerical split structure.  Categorical splits leave the
+        feature's range unrestricted (their region is not an interval) —
+        an over-approximation of the region, which can only ADD overlap
+        constraints, never drop one (safe for monotonicity)."""
+        nf = len(nb_host)
+        box = np.zeros((num_leaves, nf, 2), np.int32)
+        lo0 = np.zeros(nf, np.int32)
+        hi0 = np.asarray(nb_host, np.int32) - 1
+        if num_leaves <= 1:
+            box[0, :, 0], box[0, :, 1] = lo0, hi0
+            return box
+        stack = [(0, lo0, hi0)]
+        while stack:
+            node, lo, hi = stack.pop()
+            f = int(split_feature[node])
+            t = int(threshold_bin[node])
+            for child, is_left in ((int(left_child[node]), True),
+                                   (int(right_child[node]), False)):
+                l2, h2 = lo, hi
+                if not is_cat_node[node]:
+                    if is_left:
+                        h2 = hi.copy()
+                        h2[f] = min(h2[f], t)
+                    else:
+                        l2 = lo.copy()
+                        l2[f] = max(l2[f], t + 1)
+                if child < 0:
+                    box[~child, :, 0], box[~child, :, 1] = l2, h2
+                else:
+                    stack.append((child, l2, h2))
+        return box
+
+    def _advanced_bounds(self, boxes, leaf_value, y, num_bins_total):
+        """Per-(candidate-feature s, threshold-bin b) allowed output
+        ranges of the two children of leaf ``y`` ('advanced' method).
+
+        A leaf L' constrains a child C through monotone feature f iff
+        their regions overlap in every dim except f (then point pairs
+        differing only in f exist across them).  C's box equals y's box
+        except in the split feature s, so the qualification is
+        b-dependent exactly when s != f; because tree leaves partition
+        the space, qualifying leaves are f-disjoint from y, making the
+        s == f contribution b-independent.  Bounds for each s are
+        prefix/suffix extrema over neighbors sorted by their s-range."""
+        nf, B = boxes.shape[1], int(num_bins_total)
+        mono_np = np.asarray(self.mono)
+        neg, pos = -np.inf, np.inf
+        lo_l = np.full((nf, B), neg, np.float32)
+        lo_r = np.full((nf, B), neg, np.float32)
+        hi_l = np.full((nf, B), pos, np.float32)
+        hi_r = np.full((nf, B), pos, np.float32)
+        m = boxes.shape[0]
+        if m <= 1:
+            return lo_l, hi_l, lo_r, hi_r
+        yb = boxes[y]
+        ov = (boxes[:, :, 0] <= yb[None, :, 1]) \
+            & (yb[None, :, 0] <= boxes[:, :, 1])          # [M, F]
+        ids = np.arange(m)
+        bgrid = np.arange(B)
+        vals_all = np.asarray(leaf_value[:m], np.float64)
+        for f in np.nonzero(mono_np != 0)[0]:
+            mc = int(mono_np[f])
+            q = (ov | (np.arange(nf) == f)[None, :]).all(axis=1) \
+                & (ids != y)
+            right_nb = q & (boxes[:, f, 0] > yb[f, 1])
+            left_nb = q & (boxes[:, f, 1] < yb[f, 0])
+            ub_nb, lb_nb = (right_nb, left_nb) if mc > 0 \
+                else (left_nb, right_nb)
+            for nb_mask, is_min in ((ub_nb, True), (lb_nb, False)):
+                vals = vals_all[nb_mask]
+                if vals.size == 0:
+                    continue
+                sb = boxes[nb_mask]
+                ext = vals.min() if is_min else vals.max()
+                if is_min:
+                    hi_l[f] = np.minimum(hi_l[f], ext)
+                    hi_r[f] = np.minimum(hi_r[f], ext)
+                else:
+                    lo_l[f] = np.maximum(lo_l[f], ext)
+                    lo_r[f] = np.maximum(lo_r[f], ext)
+                acc = np.minimum if is_min else np.maximum
+                fill = pos if is_min else neg
+                for s in range(nf):
+                    if s == f:
+                        continue
+                    # left child has s-range [y.lo_s, b]: L' overlaps it
+                    # iff L'.lo_s <= b  -> running extremum by start
+                    starts = sb[:, s, 0]
+                    o = np.argsort(starts, kind="stable")
+                    run = acc.accumulate(vals[o])
+                    p1 = np.searchsorted(starts[o], bgrid, side="right") - 1
+                    b_l = np.where(p1 >= 0, run[np.maximum(p1, 0)], fill)
+                    # right child has s-range [b+1, y.hi_s]: L' overlaps
+                    # iff L'.hi_s >= b+1 -> suffix extremum by end
+                    ends = sb[:, s, 1]
+                    o2 = np.argsort(ends, kind="stable")
+                    sfx = acc.accumulate(vals[o2][::-1])[::-1]
+                    p2 = np.searchsorted(ends[o2], bgrid + 1, side="left")
+                    b_r = np.where(p2 < len(ends),
+                                   sfx[np.minimum(p2, len(ends) - 1)], fill)
+                    if is_min:
+                        hi_l[s] = np.minimum(hi_l[s], b_l)
+                        hi_r[s] = np.minimum(hi_r[s], b_r)
+                    else:
+                        lo_l[s] = np.maximum(lo_l[s], b_l)
+                        lo_r[s] = np.maximum(lo_r[s], b_r)
+        return lo_l, hi_l, lo_r, hi_r
 
     def _mono_intervals(self, num_leaves, split_feature, left_child,
                         right_child, leaf_value, is_cat_node):

@@ -132,9 +132,14 @@ class GBDTModel:
         mono_masked_ok = mono_active \
             and config.monotone_constraints_method == "basic"
         self._mono = mono if mono_active else None
+        self._inter = inter
+        # interaction constraints and bynode sampling also run in the
+        # masked grower now (per-leaf [L, F] feature-mask state / in-graph
+        # subset draws, grower.py) — only CEGB, forced splits and the
+        # non-basic monotone methods still need host orchestration
+        self._bynode_masked = config.feature_fraction_bynode < 1.0
         has_node_controls = (mono_active and not mono_masked_ok) \
-            or inter is not None or config.feature_fraction_bynode < 1.0 \
-            or self._cegb_state is not None or self._forced_spec is not None
+            or self._forced_spec is not None
 
         if has_node_controls and learner != "partitioned" \
                 and config.tpu_learner == "auto":
@@ -167,13 +172,14 @@ class GBDTModel:
             self._mesh = self._resolve_mesh(config, self._dist_axis)
             if self._mesh is None:
                 dist = None             # single device -> serial (warned)
-            elif has_node_controls:
+            elif has_node_controls or inter is not None \
+                    or self._bynode_masked or self._cegb_state is not None:
                 raise ValueError(
                     "monotone intermediate/advanced, interaction "
                     "constraints, CEGB, forced splits and "
                     "feature_fraction_bynode are not supported with "
-                    f"tree_learner={dist} (they require the single-chip "
-                    "partitioned learner); monotone basic IS supported")
+                    f"tree_learner={dist} (they require a single-chip "
+                    "learner); monotone basic IS supported")
             elif contri is not None or self._extra_trees:
                 raise ValueError(
                     "feature_contri and extra_trees are not yet supported "
@@ -314,12 +320,11 @@ class GBDTModel:
         else:
             if has_node_controls:
                 raise ValueError(
-                    "monotone intermediate/advanced, interaction "
-                    "constraints, CEGB, forced splits and "
-                    "feature_fraction_bynode currently require the "
-                    "partitioned learner (tpu_learner=partitioned, "
-                    "single-chip); monotone basic works on the masked "
-                    "learner")
+                    "monotone intermediate/advanced and forced splits "
+                    "currently require the partitioned learner "
+                    "(tpu_learner=partitioned, single-chip); monotone "
+                    "basic, interaction constraints, CEGB and "
+                    "feature_fraction_bynode work on the masked learner")
             self.grower = make_grower(
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
@@ -329,7 +334,11 @@ class GBDTModel:
                 extra_seed=config.extra_seed,
                 split_batch=self._split_batch,
                 mono=self._mono if mono_masked_ok else None,
-                mono_penalty=config.monotone_penalty)
+                mono_penalty=config.monotone_penalty,
+                interaction_allow=inter,
+                bynode_frac=config.feature_fraction_bynode,
+                bynode_seed=config.feature_fraction_seed + 1,
+                cegb=self._cegb_state)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
             raise ValueError("linear_tree requires boosting=gbdt")
@@ -689,8 +698,7 @@ class GBDTModel:
                 and self._dist is None
                 and not self._custom_hist_reduce
                 and not host_bagging
-                and self._forced_spec is None
-                and self._cegb_state is None)
+                and self._forced_spec is None)
 
     def supports_fused(self) -> bool:
         """True when whole iterations can run fused on device via
@@ -718,24 +726,42 @@ class GBDTModel:
                 split_batch=self._split_batch,
                 mono=self._mono if self._learner_kind == "masked" else None,
                 mono_penalty=cfg.monotone_penalty,
+                interaction_allow=self._inter,
+                bynode_frac=cfg.feature_fraction_bynode,
+                bynode_seed=cfg.feature_fraction_seed + 1,
+                cegb=self._cegb_state,
                 jit=False)
             obj = self.objective
             lr = jnp.float32(self.learning_rate)
             use_goss = self._goss
             ic = self._ic_grow
 
+            use_cegb = self._cegb_state is not None
+            nf = self.num_features
+
             def one_iter(carry, xs):
-                score, dead = carry
+                score, dead, cuse = carry
                 fmask, it = xs
                 g, h = obj.get_gradients(score[:, 0])
                 w = self._goss_vals(g, h, it) if use_goss \
                     else jnp.ones_like(g)
                 vals = jnp.stack([g * w, h * w, w], axis=1)
                 kw = {"is_cat": ic} if ic is not None else {}
-                if self._extra_trees:
+                if self._extra_trees or self._bynode_masked:
                     kw["rng_iter"] = it
+                if use_cegb:
+                    kw["cegb_used"] = cuse
                 arrays = grow(self.binned_dev, vals, fmask,
                               self._nb_grow, self._na_grow, **kw)
+                if use_cegb:
+                    # fold this tree's split features into the CEGB
+                    # cross-tree used set for the next scan iteration
+                    node_on = (jnp.arange(arrays.split_feature.shape[0])
+                               < arrays.num_leaves - 1)
+                    marks = jnp.zeros(nf, jnp.int32) \
+                        .at[arrays.split_feature].add(
+                            node_on.astype(jnp.int32))
+                    cuse = cuse | (marks > 0)
                 lv = arrays.leaf_value * lr
                 # per-iteration semantics stop training at the FIRST
                 # no-split tree (gbdt.cpp "no more leaves..."); once dead,
@@ -751,12 +777,13 @@ class GBDTModel:
                 # vector, ship shrunk leaf values
                 out = arrays._replace(leaf_of_row=jnp.zeros((), jnp.int32),
                                       leaf_value=lv)
-                return (score, dead), out
+                return (score, dead, cuse), out
 
             @functools.partial(jax.jit, donate_argnums=(0,))
-            def chunk(score, fmasks, iters):
-                (score, _), out = jax.lax.scan(
-                    one_iter, (score, jnp.bool_(False)), (fmasks, iters))
+            def chunk(score, fmasks, iters, cuse0):
+                (score, _, _), out = jax.lax.scan(
+                    one_iter, (score, jnp.bool_(False), cuse0),
+                    (fmasks, iters))
                 return score, out
 
             fn = self._fused_cache["chunk"] = chunk
@@ -792,7 +819,10 @@ class GBDTModel:
         else:
             fmasks = jnp.ones((k, self.num_features), bool)
         iters = jnp.arange(start_iter, start_iter + k, dtype=jnp.int32)
-        self.score, stacked = chunk(self.score, fmasks, iters)
+        cuse0 = jnp.asarray(self._cegb_state.used) \
+            if self._cegb_state is not None \
+            else jnp.zeros(1, bool)
+        self.score, stacked = chunk(self.score, fmasks, iters, cuse0)
         host = jax.device_get(stacked)          # the one sync per chunk
 
         lr = self.learning_rate
@@ -801,6 +831,11 @@ class GBDTModel:
             tj = TreeArrays(*(np.asarray(fld[j]) for fld in host))
             nl = int(tj.num_leaves)
             lvj = np.asarray(tj.leaf_value, np.float64).copy()
+            if self._cegb_state is not None and nl > 1:
+                # mirror the in-graph CEGB used-set update on the host so
+                # the NEXT chunk starts from the right cross-tree state
+                self._cegb_state.used[
+                    np.asarray(tj.split_feature)[:nl - 1]] = True
             if nl <= 1:
                 stopped = True
                 lvj[:] = 0.0
@@ -885,10 +920,18 @@ class GBDTModel:
                     gkw["forced"] = self._forced_spec
                 if self._cegb_state is not None:
                     gkw["cegb_state"] = self._cegb_state
-            elif self._extra_trees and self._dist is None:
-                # per-iteration extra_trees key component (the partitioned
-                # learner's host RNG advances statefully instead)
-                gkw["rng_iter"] = jnp.int32(self.iter_)
+            else:
+                if (self._extra_trees or self._bynode_masked) \
+                        and self._dist is None:
+                    # per-iteration extra_trees/bynode key component (the
+                    # partitioned learner's host RNG advances statefully)
+                    gkw["rng_iter"] = jnp.int32(self.iter_)
+                if self._cegb_state is not None and self._dist is None:
+                    # CEGB on the masked grower: cross-tree used-feature
+                    # state goes in as an argument; the in-tree updates
+                    # happen in-graph and are folded back below from the
+                    # fetched split records
+                    gkw["cegb_used"] = jnp.asarray(self._cegb_state.used)
             vals_g = self._prep_vals(vals)
             fmask_g = self._prep_fmask(fmask)
             if self._dist == "feature":
@@ -909,6 +952,9 @@ class GBDTModel:
             small = arrays._replace(leaf_of_row=arrays.num_leaves)
             host = jax.device_get(small)._replace(leaf_of_row=arrays.leaf_of_row)
             nl = int(host.num_leaves)
+            if "cegb_used" in gkw and nl > 1:
+                self._cegb_state.used[
+                    np.asarray(host.split_feature)[:nl - 1]] = True
             leaf_values = np.asarray(host.leaf_value, np.float64).copy()
             if nl <= 1:
                 leaf_values[:] = 0.0  # stump contributes nothing (gbdt.cpp warn)

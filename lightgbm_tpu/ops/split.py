@@ -69,6 +69,19 @@ def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
 
+def monotone_penalty_factor(penalty: float, depth):
+    """ComputeMonotoneSplitGainPenalty (monotone_constraints.hpp:355):
+    depth-based gain de-rating applied to monotone features.  ``depth``
+    may be a traced array or a host scalar; the single definition keeps
+    the masked and partitioned learners bit-consistent."""
+    pen = float(penalty)
+    d = jnp.asarray(depth, jnp.float32)
+    return jnp.where(
+        pen >= d + 1.0, 1e-15,
+        jnp.where(pen <= 1.0, 1.0 - pen / (2.0 ** d) + 1e-15,
+                  1.0 - 2.0 ** (pen - 1.0 - d) + 1e-15))
+
+
 def leaf_output(sum_g, sum_h, p: SplitParams, parent_output=None):
     """CalculateSplittedLeafOutput (feature_histogram.hpp:761-788)."""
     num = -threshold_l1(sum_g, p.lambda_l1)
@@ -219,17 +232,28 @@ def _categorical_candidates(hist, total, num_bin, cat_mask,
 
 
 def _monotone_adjust(gains, lefts, total, mono, out_lo, out_hi, dir_axis,
-                     params: SplitParams, parent_out):
+                     params: SplitParams, parent_out, mono_bounds=None):
     """Monotone-constraint filter ('basic' method,
     monotone_constraints.hpp BasicLeafConstraints): clamp candidate child
     outputs to the leaf's allowed range, recompute gains with the clamped
     outputs (GetLeafGainGivenOutput), and invalidate splits whose direction
-    violates the feature's monotonicity."""
+    violates the feature's monotonicity.
+
+    mono_bounds ('advanced' method, AdvancedLeafConstraints analog):
+    optional (lo_l, hi_l, lo_r, hi_r) per-(feature, threshold-bin) [F, B]
+    bound tensors — the allowed range of each CHILD as a function of the
+    candidate threshold, so a split is only constrained by opposite
+    leaves whose region actually overlaps that child's region."""
     rights = total[None, None, None, :] - lefts
     out_l = leaf_output(lefts[..., 0], lefts[..., 1], params, parent_out)
     out_r = leaf_output(rights[..., 0], rights[..., 1], params, parent_out)
-    cl_l = jnp.clip(out_l, out_lo, out_hi)
-    cl_r = jnp.clip(out_r, out_lo, out_hi)
+    if mono_bounds is not None:
+        lo_l, hi_l, lo_r, hi_r = (b[None] for b in mono_bounds)  # [1,F,B]
+        cl_l = jnp.clip(out_l, lo_l, hi_l)
+        cl_r = jnp.clip(out_r, lo_r, hi_r)
+    else:
+        cl_l = jnp.clip(out_l, out_lo, out_hi)
+        cl_r = jnp.clip(out_r, out_lo, out_hi)
 
     def gain_given(sums, out):
         tg = threshold_l1(sums[..., 0], params.lambda_l1)
@@ -254,7 +278,8 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
                     out_lo: jax.Array = None, out_hi: jax.Array = None,
                     gain_penalty: jax.Array = None,
                     gain_scale: jax.Array = None,
-                    rand_bin: jax.Array = None) -> SplitResult:
+                    rand_bin: jax.Array = None,
+                    mono_bounds=None) -> SplitResult:
     """Best split for one leaf across numerical and categorical features.
 
     hist:         [F, B, 3] f32 — per-feature histograms (g, h, count)
@@ -276,7 +301,7 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
                                            rand_bin)
     if mono is not None:
         ngains = _monotone_adjust(ngains, nlefts, total, mono, out_lo, out_hi,
-                                  0, params, parent_out)
+                                  0, params, parent_out, mono_bounds)
     if gain_scale is not None:
         # per-feature multiplicative gain scale: monotone_penalty
         # (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355)
@@ -356,8 +381,13 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
     ro = jnp.where(ic_, leaf_output(right_sum[0], right_sum[1], pcat, parent_out),
                    leaf_output(right_sum[0], right_sum[1], params, parent_out))
     if mono is not None:
-        lo = jnp.clip(lo, out_lo, out_hi)
-        ro = jnp.clip(ro, out_lo, out_hi)
+        if mono_bounds is not None:
+            lo_l, hi_l, lo_r, hi_r = mono_bounds
+            lo = jnp.clip(lo, lo_l[f_, t_], hi_l[f_, t_])
+            ro = jnp.clip(ro, lo_r[f_, t_], hi_r[f_, t_])
+        else:
+            lo = jnp.clip(lo, out_lo, out_hi)
+            ro = jnp.clip(ro, out_lo, out_hi)
     return SplitResult(
         gain=g_, feature=f_.astype(jnp.int32),
         threshold=t_.astype(jnp.int32), default_left=d_,

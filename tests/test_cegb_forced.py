@@ -53,3 +53,79 @@ class TestForcedSplits:
                 assert t.split_feature[1] == 6
         from lightgbm_tpu.metrics import _auc
         assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9
+
+
+class TestCEGBMasked:
+    """CEGB on the one-program masked grower (in-graph penalty vectors +
+    [F] used-feature state, grower.py) — previously partitioned-only."""
+
+    def _data(self):
+        rs = np.random.RandomState(3)
+        n = 3000
+        x = rs.randn(n, 8)
+        y = (x[:, 0] + 0.8 * x[:, 1] + 0.6 * x[:, 2]
+             + 0.1 * rs.randn(n) > 0).astype(np.float32)
+        return x, y
+
+    def test_masked_matches_partitioned(self):
+        x, y = self._data()
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "verbose": -1,
+             "cegb_tradeoff": 0.5,
+             "cegb_penalty_feature_coupled": [5.0] * 8}
+        b_m = lgb.train({**p, "tpu_learner": "masked"},
+                        lgb.Dataset(x, label=y), num_boost_round=8)
+        b_p = lgb.train({**p, "tpu_learner": "partitioned"},
+                        lgb.Dataset(x, label=y), num_boost_round=8)
+        assert b_m._model._learner_kind == "masked"
+        for tm, tp in zip(b_m.trees, b_p.trees):
+            np.testing.assert_array_equal(tm.split_feature, tp.split_feature)
+            np.testing.assert_allclose(tm.leaf_value, tp.leaf_value,
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_masked_coupled_concentrates_features(self):
+        """Coupled acquisition penalties make later splits prefer already-
+        bought features (the CEGB point)."""
+        x, y = self._data()
+        base = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+                "min_data_in_leaf": 5, "verbose": -1,
+                "tpu_learner": "masked"}
+        b0 = lgb.train(base, lgb.Dataset(x, label=y), num_boost_round=10)
+        b1 = lgb.train({**base, "cegb_tradeoff": 1.0,
+                        "cegb_penalty_feature_coupled": [50.0] * 8},
+                       lgb.Dataset(x, label=y), num_boost_round=10)
+        nfeat = [len({int(f) for t in b.trees
+                      for f in np.asarray(t.split_feature)[:t.num_leaves - 1]})
+                 for b in (b0, b1)]
+        assert nfeat[1] <= nfeat[0]
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, b1.predict(x)) > 0.8
+
+    def test_masked_fused_equals_per_iter(self):
+        x, y = self._data()
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "verbose": -1, "tpu_learner": "masked",
+             "cegb_tradeoff": 0.7, "cegb_penalty_split": 1e-5,
+             "cegb_penalty_feature_coupled": [10.0] * 8}
+        b_it = lgb.train(dict(p, fused_chunk=0), lgb.Dataset(x, label=y),
+                         num_boost_round=8)
+        b_fu = lgb.train(dict(p, fused_chunk=4), lgb.Dataset(x, label=y),
+                         num_boost_round=8)
+        np.testing.assert_array_equal(b_it.predict(x), b_fu.predict(x))
+
+    def test_masked_batched_cegb(self):
+        x, y = self._data()
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "verbose": -1, "tpu_learner": "masked",
+             "split_batch": 4, "cegb_tradeoff": 0.7,
+             "cegb_penalty_feature_coupled": [10.0] * 8}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=8)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, bst.predict(x)) > 0.8
+
+    def test_dist_cegb_refused(self):
+        x, y = self._data()
+        with pytest.raises(ValueError, match="CEGB"):
+            lgb.train({"objective": "binary", "tree_learner": "data",
+                       "cegb_penalty_split": 1e-4, "verbose": -1},
+                      lgb.Dataset(x, label=y), num_boost_round=2)

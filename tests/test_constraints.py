@@ -126,8 +126,10 @@ class TestInteraction:
 
 class TestMonotoneMethodSweep:
     """VERDICT r2 task 8: property test across every
-    monotone_constraints_method — zero violations on random data, and the
-    'advanced' fallback to intermediate must be loud, not silent."""
+    monotone_constraints_method — zero violations on random data.
+    'advanced' is now a real implementation (per-threshold neighbor
+    bounds from leaf boxes, grower_partitioned._advanced_bounds), not a
+    fallback."""
 
     @pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
     @pytest.mark.parametrize("seed", [0, 7])
@@ -140,21 +142,21 @@ class TestMonotoneMethodSweep:
         assert _check_monotone(bst, 0, +1), f"{method}: not increasing in x0"
         assert _check_monotone(bst, 1, -1), f"{method}: not decreasing in x1"
 
-    def test_advanced_fallback_warns(self):
-        import lightgbm_tpu.utils.log as loglib
-        msgs = []
-        orig = loglib.Log.warning
-        loglib.Log.warning = staticmethod(lambda m: msgs.append(m))
-        try:
-            x, y = _mono_data()
-            p = {"objective": "regression", "num_leaves": 15, "max_bin": 31,
-                 "monotone_constraints": [1, -1, 0],
-                 "monotone_constraints_method": "advanced", "verbosity": -1}
-            lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=2)
-        finally:
-            loglib.Log.warning = orig
-        assert any("advanced" in m and "intermediate" in m for m in msgs), \
-            f"no loud fallback warning, got {msgs}"
+    def test_advanced_at_least_as_accurate(self):
+        """The point of 'advanced' (monotone_constraints.hpp:856): only
+        constrain where regions actually interact, recovering gain the
+        midpoint method forfeits — train loss should not be worse than
+        'basic' by more than noise."""
+        x, y = _mono_data(seed=3)
+        losses = {}
+        for method in ("basic", "advanced"):
+            p = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+                 "min_data_in_leaf": 10, "monotone_constraints": [1, -1, 0],
+                 "monotone_constraints_method": method, "verbosity": -1}
+            bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=25)
+            pred = bst.predict(x)
+            losses[method] = float(np.mean((pred - y) ** 2))
+        assert losses["advanced"] <= losses["basic"] * 1.05, losses
 
 
 class TestMonotoneMasked:
@@ -240,3 +242,88 @@ class TestMonotoneMasked:
                         lgb.Dataset(x, label=y), num_boost_round=5)
         assert bst._model._learner_kind == "partitioned"
         assert _check_monotone(bst, 0, +1)
+
+
+class TestInteractionMasked:
+    """Interaction constraints + feature_fraction_bynode on the masked
+    grower (per-leaf [L, F] feature-mask state / in-graph subset draws,
+    grower.py) — previously host-orchestrated only."""
+
+    def _paths_ok(self, bst, groups):
+        for t in bst.trees:
+            if t.num_nodes() == 0:
+                continue
+
+            def paths(node, feats):
+                if node < 0:
+                    yield feats
+                    return
+                nf = feats | {int(t.split_feature[node])}
+                yield from paths(t.left_child[node], nf)
+                yield from paths(t.right_child[node], nf)
+            for feats in paths(0, set()):
+                assert any(feats <= g for g in groups), \
+                    f"path mixes groups: {feats}"
+
+    def test_masked_interaction_respected(self):
+        rs = np.random.RandomState(0)
+        n = 3000
+        x = rs.randn(n, 4)
+        y = (x[:, 0] * x[:, 1] + x[:, 2] + 0.1 * rs.randn(n)).astype(np.float32)
+        p = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "tpu_learner": "masked",
+             "interaction_constraints": "[0,1],[2,3]", "verbose": -1}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        assert bst._model._learner_kind == "masked"
+        self._paths_ok(bst, [{0, 1}, {2, 3}])
+
+    def test_masked_interaction_batched_and_fused(self):
+        rs = np.random.RandomState(1)
+        n = 3000
+        x = rs.randn(n, 4)
+        y = (x[:, 0] * x[:, 1] + x[:, 2] + 0.1 * rs.randn(n)).astype(np.float32)
+        p = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+             "min_data_in_leaf": 5, "tpu_learner": "masked",
+             "interaction_constraints": "[0,1],[2,3]", "verbose": -1,
+             "split_batch": 4, "fused_chunk": 5}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        self._paths_ok(bst, [{0, 1}, {2, 3}])
+
+    def test_masked_bynode(self, binary_data):
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "feature_fraction_bynode": 0.5, "tpu_learner": "masked",
+             "verbose": -1}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
+        assert bst._model._learner_kind == "masked"
+        from lightgbm_tpu.metrics import _auc
+        assert _auc(y, bst.predict(x, raw_score=True), None) > 0.9
+        # bynode actually varies the chosen features across nodes: with
+        # frac=0.5 of 20 features, a single tree using only the global
+        # best feature everywhere is the degenerate failure
+        feats = {int(f) for t in bst.trees
+                 for f in np.asarray(t.split_feature)[:t.num_leaves - 1]}
+        assert len(feats) > 3
+
+    def test_masked_bynode_fused_equals_per_iter(self, binary_data):
+        """bynode keys are derived from (seed, iteration, step, child)
+        in-graph, so the fused scan reproduces the per-iteration stream."""
+        x, y = binary_data
+        p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+             "feature_fraction_bynode": 0.5, "tpu_learner": "masked",
+             "verbose": -1}
+        b_it = lgb.train(dict(p, fused_chunk=0), lgb.Dataset(x, label=y),
+                         num_boost_round=8)
+        b_fu = lgb.train(dict(p, fused_chunk=4), lgb.Dataset(x, label=y),
+                         num_boost_round=8)
+        np.testing.assert_array_equal(b_it.predict(x), b_fu.predict(x))
+
+    def test_dist_interaction_refused(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(400, 4)
+        y = (x[:, 0] > 0).astype(np.float32)
+        with pytest.raises(ValueError, match="interaction"):
+            lgb.train({"objective": "binary", "tree_learner": "data",
+                       "interaction_constraints": "[0,1],[2,3]",
+                       "verbose": -1},
+                      lgb.Dataset(x, label=y), num_boost_round=2)

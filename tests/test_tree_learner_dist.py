@@ -84,10 +84,18 @@ class TestDataParallelE2E:
         assert ll[-1] < ll[0]
 
     def test_node_controls_rejected(self, binary_data):
+        """Monotone 'basic' is SUPPORTED under the data-parallel learner
+        now (tests/test_constraints.py TestMonotoneMasked); the remaining
+        host-orchestrated controls still reject with a clear error."""
         x, y = binary_data
         with pytest.raises(ValueError, match="tree_learner=data"):
             _train(dict(BASE, tree_learner="data",
-                        monotone_constraints=[1] * x.shape[1]), x, y, 1)
+                        monotone_constraints=[1] * x.shape[1],
+                        monotone_constraints_method="intermediate"),
+                   x, y, 1)
+        with pytest.raises(ValueError, match="tree_learner=data"):
+            _train(dict(BASE, tree_learner="data",
+                        feature_fraction_bynode=0.5), x, y, 1)
 
 
 class TestFeatureParallelE2E:

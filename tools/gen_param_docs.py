@@ -1,0 +1,95 @@
+"""Generate docs/Parameters.md from the single config table of record.
+
+The reference generates docs/Parameters.rst AND its parsing code from
+config.h header comments via helpers/parameter_generator.py; here the
+``_PARAMS`` table in lightgbm_tpu/config.py is the single source, and this
+script renders it (grouped by the table's section comments) so docs can
+never drift from the accepted surface.
+
+Run: python tools/gen_param_docs.py   (writes docs/Parameters.md)
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lightgbm_tpu.config import _PARAMS  # noqa: E402
+
+CONFIG_PY = os.path.join(os.path.dirname(__file__), "..", "lightgbm_tpu",
+                         "config.py")
+OUT = os.path.join(os.path.dirname(__file__), "..", "docs", "Parameters.md")
+
+
+def sections():
+    """(section_title, [param names]) in table order, from the
+    ``# ---- section ----`` comments inside _PARAMS."""
+    src = open(CONFIG_PY).read()
+    body = src.split("_PARAMS: Dict[str, tuple] = {", 1)[1]
+    body = body.split("\n}", 1)[0]
+    out, cur, title = [], [], "core"
+    for line in body.splitlines():
+        m = re.match(r"\s*# ---- (.+?) ----", line)
+        if m:
+            if cur:
+                out.append((title, cur))
+            title, cur = m.group(1), []
+            continue
+        pm = re.match(r'\s*"([a-z0-9_]+)":', line)
+        if pm and pm.group(1) in _PARAMS:
+            cur.append(pm.group(1))
+    if cur:
+        out.append((title, cur))
+    return out
+
+
+def fmt_default(v):
+    if v is None:
+        return "`None`"
+    if isinstance(v, bool):
+        return "`true`" if v else "`false`"
+    if isinstance(v, str):
+        return f'`"{v}"`' if v else '`""`'
+    return f"`{v}`"
+
+
+def main():
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` `_PARAMS` — the single",
+        "table of record for names, types, defaults and aliases (the",
+        "analog of the reference's docs/Parameters.rst, which is likewise",
+        "generated from its config source).  Regenerate with",
+        "`python tools/gen_param_docs.py`; do not edit by hand.",
+        "",
+        "Aliases resolve to the canonical name exactly as in the",
+        "reference (`Config::Set` alias table).  Unknown keys are kept",
+        "and ignored, matching the reference's pass-through behavior.",
+        "",
+    ]
+    total = 0
+    for title, names in sections():
+        lines += [f"## {title}", "",
+                  "| Parameter | Type | Default | Aliases |",
+                  "|---|---|---|---|"]
+        for name in names:
+            typ, default, aliases = _PARAMS[name]
+            al = ", ".join(f"`{a}`" for a in aliases) if aliases else "—"
+            lines.append(f"| `{name}` | {typ.__name__} | "
+                         f"{fmt_default(default)} | {al} |")
+            total += 1
+        lines.append("")
+    assert total == len(_PARAMS), \
+        f"section scan covered {total} of {len(_PARAMS)} params"
+    lines.append(f"_{total} parameters._")
+    lines.append("")
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {os.path.relpath(OUT)} ({total} params)")
+
+
+if __name__ == "__main__":
+    main()
