@@ -307,6 +307,58 @@ def booster_reset_parameter(bst: Booster, params: str) -> None:
     bst.reset_parameter(_params(params))
 
 
+def booster_dump_model(bst: Booster, start_iteration: int,
+                       num_iteration: int) -> str:
+    """JSON model dump (LGBM_BoosterDumpModel, c_api.h; DumpModel)."""
+    import json
+    num = num_iteration if num_iteration > 0 else None
+    return json.dumps(bst.dump_model(num_iteration=num,
+                                     start_iteration=int(start_iteration)))
+
+
+def booster_refit(bst: Booster, mv, nrow: int, ncol: int, label_mv,
+                  decay_rate: float) -> Booster:
+    """Refit existing tree structures on new data
+    (LGBM_BoosterRefit, c_api.h; GBDT::RefitTree gbdt.cpp:287)."""
+    x = np.frombuffer(mv, np.float64).reshape(int(nrow), int(ncol)).copy()
+    label = np.frombuffer(label_mv, np.float32)[:int(nrow)].copy()
+    return bst.refit(x, label, decay_rate=float(decay_rate))
+
+
+def dataset_save_binary(ds, filename: str) -> None:
+    """Binary dataset cache (LGBM_DatasetSaveBinary, c_api.h;
+    Dataset::SaveBinaryFile)."""
+    ds = _as_dataset(ds)
+    ds.construct()
+    ds.save_binary(filename)
+
+
+def dataset_get_feature_names(ds) -> str:
+    ds = _as_dataset(ds)
+    ds.construct()
+    names = ds.feature_names or [
+        f"Column_{i}" for i in range(ds.num_total_features)]
+    return "\t".join(names)
+
+
+def dataset_set_feature_names(ds, names: str) -> None:
+    ds = _as_dataset(ds)
+    lst = names.split("\t")
+    nf = getattr(ds, "num_total_features", 0)
+    if not nf:
+        # pre-construct: the raw input's width is already known
+        raw = getattr(ds, "_raw_input", None)
+        nf = raw.shape[1] if raw is not None \
+            and hasattr(raw, "shape") and len(raw.shape) == 2 else 0
+    if nf and len(lst) != nf:
+        # fail at the API call, not later inside dump_model/save
+        raise ValueError(f"{len(lst)} feature names for {nf} features")
+    # set the constructor-style input too: construct()'s _resolve_names
+    # would otherwise overwrite the assignment with Column_N defaults
+    ds._feature_name_in = lst
+    ds.feature_names = lst
+
+
 # ---------------------------------------------------------------------------
 # Network init (LGBM_NetworkInit, c_api.h:1350).  The reference builds its
 # socket-collective mesh from a machine list; the TPU framework's

@@ -481,22 +481,23 @@ class PartitionedGrower:
                 # AdvancedLeafConstraints GoUpToFindLeavesToUpdate role,
                 # as a box-overlap filter instead of a tree up-walk)
                 num_leaves_next = new + 1
-                boxes = self._leaf_boxes(
+                boxes_int, boxes_wide = self._leaf_boxes(
                     num_leaves_next, split_feature, threshold_bin,
                     left_child, right_child, is_cat_node,
-                    np.asarray(num_bin))
+                    np.asarray(num_bin), default_left=default_left,
+                    na_host=na_host)
                 mono_np = np.asarray(self.mono)
                 mono_feats = np.nonzero(mono_np != 0)[0]
-                nf_b = boxes.shape[1]
-                cand_boxes = [boxes[leaf], boxes[new]]
+                nf_b = boxes_wide.shape[1]
+                cand_boxes = [boxes_wide[leaf], boxes_wide[new]]
                 if adv_prev_boxes[0] is not None \
                         and leaf < len(adv_prev_boxes[0]):
                     cand_boxes.append(adv_prev_boxes[0][leaf])
 
                 def _could_constrain(l):
                     for cb in cand_boxes:
-                        ov = (cb[:, 0] <= boxes[l, :, 1]) \
-                            & (boxes[l, :, 0] <= cb[:, 1])
+                        ov = (cb[:, 0] <= boxes_wide[l, :, 1]) \
+                            & (boxes_wide[l, :, 0] <= cb[:, 1])
                         for f in mono_feats:
                             if ov.sum() >= nf_b - (0 if ov[f] else 1):
                                 if np.all(ov | (np.arange(nf_b) == f)):
@@ -506,8 +507,9 @@ class PartitionedGrower:
                 for l in range(num_leaves_next):
                     if l in (leaf, new) or l not in adv_bounds \
                             or _could_constrain(l):
-                        nbnd = self._advanced_bounds(boxes, leaf_value, l,
-                                                     B)
+                        nbnd = self._advanced_bounds(
+                            boxes_int, boxes_wide, leaf_value, l, B,
+                            na_host=na_host)
                         old = adv_bounds.get(l)
                         if l not in (leaf, new) and (
                                 old is None or any(
@@ -519,7 +521,7 @@ class PartitionedGrower:
                     # bounds replace it) but must exist for _find_leaf
                     leaf_lo.setdefault(l, -inf)
                     leaf_hi.setdefault(l, inf)
-                adv_prev_boxes[0] = boxes
+                adv_prev_boxes[0] = boxes_wide
             elif use_intermediate:
                 # recompute the whole frontier's intervals from the actual
                 # opposite-subtree outputs (IntermediateLeafConstraints
@@ -626,41 +628,62 @@ class PartitionedGrower:
 
     @staticmethod
     def _leaf_boxes(num_leaves, split_feature, threshold_bin, left_child,
-                    right_child, is_cat_node, nb_host):
-        """[M, F, 2] inclusive bin-range bounding box per current leaf,
-        from the numerical split structure.  Categorical splits leave the
-        feature's range unrestricted (their region is not an interval) —
-        an over-approximation of the region, which can only ADD overlap
-        constraints, never drop one (safe for monotonicity)."""
+                    right_child, is_cat_node, nb_host, default_left=None,
+                    na_host=None):
+        """Per-leaf bin-range boxes from the numerical split structure,
+        as TWO [M, F, 2] arrays:
+
+        - ``box_int``: the pure interval part (may be empty, lo > hi, for
+          a child whose only rows are NA-routed).  Used for ORDERING
+          along a monotone feature — NaN values are unordered, so only
+          interval parts create left-of/right-of relations.
+        - ``box_wide``: widened over the NaN bin for the child that
+          receives NA rows by default_left, and over the full range for
+          categorical splits — used for region-OVERLAP tests, where
+          over-approximation can only ADD constraints (safe)."""
         nf = len(nb_host)
-        box = np.zeros((num_leaves, nf, 2), np.int32)
+        box_i = np.zeros((num_leaves, nf, 2), np.int32)
+        box_w = np.zeros((num_leaves, nf, 2), np.int32)
         lo0 = np.zeros(nf, np.int32)
         hi0 = np.asarray(nb_host, np.int32) - 1
         if num_leaves <= 1:
-            box[0, :, 0], box[0, :, 1] = lo0, hi0
-            return box
-        stack = [(0, lo0, hi0)]
+            for b in (box_i, box_w):
+                b[0, :, 0], b[0, :, 1] = lo0, hi0
+            return box_i, box_w
+        stack = [(0, lo0, hi0, lo0, hi0)]
         while stack:
-            node, lo, hi = stack.pop()
+            node, lo, hi, wlo, whi = stack.pop()
             f = int(split_feature[node])
             t = int(threshold_bin[node])
+            na = -1 if na_host is None else int(na_host[f])
+            dl = bool(default_left[node]) if default_left is not None \
+                else False
             for child, is_left in ((int(left_child[node]), True),
                                    (int(right_child[node]), False)):
-                l2, h2 = lo, hi
+                l2, h2, wl2, wh2 = lo, hi, wlo, whi
                 if not is_cat_node[node]:
                     if is_left:
-                        h2 = hi.copy()
+                        h2, wh2 = hi.copy(), whi.copy()
                         h2[f] = min(h2[f], t)
+                        wh2[f] = min(wh2[f], t)
                     else:
-                        l2 = lo.copy()
+                        l2, wl2 = lo.copy(), wlo.copy()
                         l2[f] = max(l2[f], t + 1)
+                        wl2[f] = max(wl2[f], t + 1)
+                    if na >= 0 and (dl == is_left):
+                        wl2 = wl2.copy()
+                        wh2 = wh2.copy()
+                        wl2[f] = min(wl2[f], na)
+                        wh2[f] = max(wh2[f], na)
                 if child < 0:
-                    box[~child, :, 0], box[~child, :, 1] = l2, h2
+                    box_i[~child, :, 0], box_i[~child, :, 1] = l2, h2
+                    box_w[~child, :, 0], box_w[~child, :, 1] = wl2, wh2
                 else:
-                    stack.append((child, l2, h2))
-        return box
+                    stack.append((child, l2, h2, wl2, wh2))
+        return box_i, box_w
 
-    def _advanced_bounds(self, boxes, leaf_value, y, num_bins_total):
+    def _advanced_bounds(self, boxes_int, boxes_wide, leaf_value, y,
+                         num_bins_total, na_host=None):
         """Per-(candidate-feature s, threshold-bin b) allowed output
         ranges of the two children of leaf ``y`` ('advanced' method).
 
@@ -669,71 +692,98 @@ class PartitionedGrower:
         differing only in f exist across them).  C's box equals y's box
         except in the split feature s, so the qualification is
         b-dependent exactly when s != f; because tree leaves partition
-        the space, qualifying leaves are f-disjoint from y, making the
-        s == f contribution b-independent.  Bounds for each s are
-        prefix/suffix extrema over neighbors sorted by their s-range."""
-        nf, B = boxes.shape[1], int(num_bins_total)
+        the space, qualifying leaves' interval parts are f-disjoint from
+        y's, making the s == f contribution b-independent.
+
+        Ordering along f uses INTERVAL boxes (NaN is unordered, so only
+        finite f-ranges create left-of/right-of relations; leaves whose
+        f-interval is empty impose nothing through f), while every
+        overlap test uses the NA-WIDENED boxes, plus an escape that keeps
+        a constraint active at all thresholds of s when both regions
+        cover s's NaN bin (NA rows follow default_left regardless of the
+        threshold).  MissingType.Zero gets the same treatment on purpose:
+        the model ROUTES zeros by default_left exactly like NaN
+        (tree.h NumericalDecision), so zeros sit outside the ordered
+        threshold geometry — matching the reference, whose monotone
+        constraints also do not order the missing-routed branch."""
+        nf, B = boxes_int.shape[1], int(num_bins_total)
         mono_np = np.asarray(self.mono)
         neg, pos = -np.inf, np.inf
         lo_l = np.full((nf, B), neg, np.float32)
         lo_r = np.full((nf, B), neg, np.float32)
         hi_l = np.full((nf, B), pos, np.float32)
         hi_r = np.full((nf, B), pos, np.float32)
-        m = boxes.shape[0]
+        m = boxes_int.shape[0]
         if m <= 1:
             return lo_l, hi_l, lo_r, hi_r
-        yb = boxes[y]
-        ov = (boxes[:, :, 0] <= yb[None, :, 1]) \
-            & (yb[None, :, 0] <= boxes[:, :, 1])          # [M, F]
+        ybi, ybw = boxes_int[y], boxes_wide[y]
+        ov = (boxes_wide[:, :, 0] <= ybw[None, :, 1]) \
+            & (ybw[None, :, 0] <= boxes_wide[:, :, 1])    # [M, F]
         ids = np.arange(m)
         bgrid = np.arange(B)
         vals_all = np.asarray(leaf_value[:m], np.float64)
+        if na_host is not None:
+            na_s = np.asarray(na_host)
+            cov_nb = (na_s[None, :] >= 0) \
+                & (boxes_wide[:, :, 0] <= na_s[None, :]) \
+                & (na_s[None, :] <= boxes_wide[:, :, 1])  # [M, F]
+            cov_y = (na_s >= 0) & (ybw[:, 0] <= na_s) & (na_s <= ybw[:, 1])
+            na_escape = cov_nb & cov_y[None, :]
+        else:
+            na_escape = np.zeros((m, nf), bool)
         for f in np.nonzero(mono_np != 0)[0]:
             mc = int(mono_np[f])
             q = (ov | (np.arange(nf) == f)[None, :]).all(axis=1) \
                 & (ids != y)
-            right_nb = q & (boxes[:, f, 0] > yb[f, 1])
-            left_nb = q & (boxes[:, f, 1] < yb[f, 0])
+            nonempty = boxes_int[:, f, 0] <= boxes_int[:, f, 1]
+            right_nb = q & nonempty & (boxes_int[:, f, 0] > ybi[f, 1])
+            left_nb = q & nonempty & (boxes_int[:, f, 1] < ybi[f, 0])
             ub_nb, lb_nb = (right_nb, left_nb) if mc > 0 \
                 else (left_nb, right_nb)
             for nb_mask, is_min in ((ub_nb, True), (lb_nb, False)):
                 vals = vals_all[nb_mask]
                 if vals.size == 0:
                     continue
-                sb = boxes[nb_mask]
+                sb = boxes_wide[nb_mask]
                 ext = vals.min() if is_min else vals.max()
-                if is_min:
-                    hi_l[f] = np.minimum(hi_l[f], ext)
-                    hi_r[f] = np.minimum(hi_r[f], ext)
-                else:
-                    lo_l[f] = np.maximum(lo_l[f], ext)
-                    lo_r[f] = np.maximum(lo_r[f], ext)
-                acc = np.minimum if is_min else np.maximum
                 fill = pos if is_min else neg
-                for s in range(nf):
-                    if s == f:
-                        continue
-                    # left child has s-range [y.lo_s, b]: L' overlaps it
-                    # iff L'.lo_s <= b  -> running extremum by start
-                    starts = sb[:, s, 0]
-                    o = np.argsort(starts, kind="stable")
-                    run = acc.accumulate(vals[o])
-                    p1 = np.searchsorted(starts[o], bgrid, side="right") - 1
-                    b_l = np.where(p1 >= 0, run[np.maximum(p1, 0)], fill)
-                    # right child has s-range [b+1, y.hi_s]: L' overlaps
-                    # iff L'.hi_s >= b+1 -> suffix extremum by end
-                    ends = sb[:, s, 1]
-                    o2 = np.argsort(ends, kind="stable")
-                    sfx = acc.accumulate(vals[o2][::-1])[::-1]
-                    p2 = np.searchsorted(ends[o2], bgrid + 1, side="left")
-                    b_r = np.where(p2 < len(ends),
-                                   sfx[np.minimum(p2, len(ends) - 1)], fill)
+                # broadcast pass over (s, b):
+                # left child's s-range is [y.lo_s, b] -> L' overlaps iff
+                # L'.lo_s <= b; right child's is [b+1, y.hi_s] -> iff
+                # L'.hi_s >= b+1.  Masked extremum over the K neighbors,
+                # chunked over the s axis so the [K, s_chunk, B]
+                # temporaries stay bounded (~8 MB) at wide/high-bin
+                # shapes instead of multi-GB churn.
+                k_nb = len(vals)
+                vb = vals.astype(np.float32)[:, None, None]
+                esc_all = na_escape[nb_mask]
+                c_l = np.empty((nf, B), np.float32)
+                c_r = np.empty((nf, B), np.float32)
+                s_chunk = max(1, (1 << 21) // max(k_nb * B, 1))
+                for s0 in range(0, nf, s_chunk):
+                    sl = slice(s0, min(s0 + s_chunk, nf))
+                    m_l = sb[:, sl, 0][:, :, None] <= bgrid[None, None, :]
+                    m_r = sb[:, sl, 1][:, :, None] \
+                        >= (bgrid + 1)[None, None, :]
+                    esc = esc_all[:, sl, None]
+                    m_l = m_l | esc
+                    m_r = m_r | esc
                     if is_min:
-                        hi_l[s] = np.minimum(hi_l[s], b_l)
-                        hi_r[s] = np.minimum(hi_r[s], b_r)
+                        c_l[sl] = np.where(m_l, vb, fill).min(axis=0)
+                        c_r[sl] = np.where(m_r, vb, fill).min(axis=0)
                     else:
-                        lo_l[s] = np.maximum(lo_l[s], b_l)
-                        lo_r[s] = np.maximum(lo_r[s], b_r)
+                        c_l[sl] = np.where(m_l, vb, fill).max(axis=0)
+                        c_r[sl] = np.where(m_r, vb, fill).max(axis=0)
+                # splits ON f itself: qualifying leaves are f-disjoint
+                # from y, so the bound is b-independent for both children
+                c_l[f, :] = ext
+                c_r[f, :] = ext
+                if is_min:
+                    hi_l = np.minimum(hi_l, c_l)
+                    hi_r = np.minimum(hi_r, c_r)
+                else:
+                    lo_l = np.maximum(lo_l, c_l)
+                    lo_r = np.maximum(lo_r, c_r)
         return lo_l, hi_l, lo_r, hi_r
 
     def _mono_intervals(self, num_leaves, split_feature, left_child,

@@ -521,6 +521,90 @@ int LGBM_TrainBoosterFeatureImportance(BoosterHandle handle,
   return 0;
 }
 
+// JSON model dump (LGBM_BoosterDumpModel, c_api.h)
+int LGBM_TrainBoosterDumpModel(BoosterHandle handle, int start_iteration,
+                               int num_iteration, const char** out_str) {
+  Gil gil;
+  static thread_local std::string buf;
+  PyObject* args = Py_BuildValue("(Oii)", reinterpret_cast<PyObject*>(handle),
+                                 start_iteration, num_iteration);
+  PyObject* r = Call("booster_dump_model", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  const char* p = PyUnicode_AsUTF8(r);
+  if (!p) {
+    Py_DECREF(r);
+    return PyError();
+  }
+  buf = p;
+  Py_DECREF(r);
+  *out_str = buf.c_str();
+  return 0;
+}
+
+// Refit existing tree structures on new data (LGBM_BoosterRefit analog;
+// returns a NEW booster handle — the JAX-side refit is functional).
+int LGBM_TrainBoosterRefit(BoosterHandle handle, const double* data,
+                           int32_t nrow, int32_t ncol, const float* label,
+                           double decay_rate, BoosterHandle* out) {
+  Gil gil;
+  PyObject* mv = View(data, static_cast<Py_ssize_t>(nrow) * ncol * 8);
+  PyObject* lv = View(label, static_cast<Py_ssize_t>(nrow) * 4);
+  PyObject* args = Py_BuildValue("(OOiiOd)",
+                                 reinterpret_cast<PyObject*>(handle), mv,
+                                 (int)nrow, (int)ncol, lv, decay_rate);
+  Py_DECREF(mv);
+  Py_DECREF(lv);
+  PyObject* r = Call("booster_refit", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+int LGBM_TrainDatasetSaveBinary(DatasetHandle handle, const char* filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                                 filename);
+  PyObject* r = Call("dataset_save_binary", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+// tab-separated names (LGBM_DatasetGetFeatureNames / SetFeatureNames)
+int LGBM_TrainDatasetGetFeatureNames(DatasetHandle handle,
+                                     const char** out_str) {
+  Gil gil;
+  static thread_local std::string buf;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle));
+  PyObject* r = Call("dataset_get_feature_names", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  const char* p = PyUnicode_AsUTF8(r);
+  if (!p) {
+    Py_DECREF(r);
+    return PyError();
+  }
+  buf = p;
+  Py_DECREF(r);
+  *out_str = buf.c_str();
+  return 0;
+}
+
+int LGBM_TrainDatasetSetFeatureNames(DatasetHandle handle,
+                                     const char* names) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                                 names ? names : "");
+  PyObject* r = Call("dataset_set_feature_names", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
 int LGBM_TrainBoosterResetParameter(BoosterHandle handle,
                                     const char* parameters) {
   Gil gil;

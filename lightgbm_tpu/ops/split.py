@@ -383,8 +383,24 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
     if mono is not None:
         if mono_bounds is not None:
             lo_l, hi_l, lo_r, hi_r = mono_bounds
-            lo = jnp.clip(lo, lo_l[f_, t_], hi_l[f_, t_])
-            ro = jnp.clip(ro, lo_r[f_, t_], hi_r[f_, t_])
+            # categorical winners: t_ is a category rank, not an interval
+            # threshold, and the children are not f_-intervals — clamp
+            # with the tightest bound over ALL thresholds of the feature
+            # (conservative).  If that intersection is empty (mutually
+            # contradictory neighbor bounds), no output satisfies every
+            # constraint; keep the interval well-ordered so clip stays
+            # deterministic (lower bound wins) instead of returning the
+            # violated hi.
+            l_lo = jnp.where(ic_, jnp.max(lo_l[f_]), lo_l[f_, t_])
+            l_hi = jnp.where(ic_,
+                             jnp.maximum(jnp.min(hi_l[f_]), jnp.max(lo_l[f_])),
+                             hi_l[f_, t_])
+            r_lo = jnp.where(ic_, jnp.max(lo_r[f_]), lo_r[f_, t_])
+            r_hi = jnp.where(ic_,
+                             jnp.maximum(jnp.min(hi_r[f_]), jnp.max(lo_r[f_])),
+                             hi_r[f_, t_])
+            lo = jnp.clip(lo, l_lo, l_hi)
+            ro = jnp.clip(ro, r_lo, r_hi)
         else:
             lo = jnp.clip(lo, out_lo, out_hi)
             ro = jnp.clip(ro, out_lo, out_hi)

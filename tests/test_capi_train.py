@@ -392,3 +392,59 @@ def test_network_init_validation():
     # single machine is a no-op success (reference behavior)
     assert lib.LGBM_TrainNetworkInit(b"", 12400, 120, 1) == 0
     assert lib.LGBM_TrainNetworkFree() == 0
+
+
+def test_dump_refit_binary_and_feature_names(tmp_path):
+    lib = _lib()
+    x, y = _data(n=500, f=4, seed=7)
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_TrainDatasetCreateFromMat(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), x.shape[0],
+        x.shape[1], b"max_bin=63 verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_TrainDatasetSetFeatureNames(
+        ds, b"alpha\tbeta\tgamma\tdelta") == 0
+    names = ctypes.c_char_p()
+    assert lib.LGBM_TrainDatasetGetFeatureNames(ds, ctypes.byref(names)) == 0
+    assert names.value == b"alpha\tbeta\tgamma\tdelta"
+    assert lib.LGBM_TrainDatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), len(y), 0) == 0
+
+    bst, model_str = _train_c(lib, ds, rounds=5)
+    assert "alpha" in model_str
+
+    # JSON dump parses and carries the trees
+    js = ctypes.c_char_p()
+    assert lib.LGBM_TrainBoosterDumpModel(bst, 0, -1, ctypes.byref(js)) == 0
+    import json as _json
+    dump = _json.loads(js.value.decode())
+    assert dump["num_tree_per_iteration"] == 1
+    assert len(dump["tree_info"]) == 5
+    assert dump["feature_names"][0] == "alpha"
+
+    # refit on perturbed data returns a working new booster
+    x2 = np.ascontiguousarray(x + 0.01)
+    y2 = y.astype(np.float32)
+    b2 = ctypes.c_void_p()
+    rc = lib.LGBM_TrainBoosterRefit(
+        bst, x2.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        x2.shape[0], x2.shape[1], y2.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_double(0.9), ctypes.byref(b2))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    out = np.zeros(x.shape[0], np.float64)
+    out_len = ctypes.c_int64()
+    assert lib.LGBM_TrainBoosterPredictForMat(
+        b2, x2.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), x2.shape[0],
+        x2.shape[1], 0, 0, -1, len(out),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(out_len)) == 0
+    acc = ((out > 0.5) == y2).mean()
+    assert acc > 0.85, acc
+
+    # binary dataset cache from C loads back in Python
+    binpath = str(tmp_path / "c.ds.bin").encode()
+    assert lib.LGBM_TrainDatasetSaveBinary(ds, binpath) == 0
+    ds2 = lgb.Dataset.load_binary(binpath.decode())
+    assert ds2.num_data == 500
+    lib.LGBM_TrainBoosterFree(bst)
+    lib.LGBM_TrainBoosterFree(b2)
+    lib.LGBM_TrainDatasetFree(ds)

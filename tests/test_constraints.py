@@ -142,6 +142,21 @@ class TestMonotoneMethodSweep:
         assert _check_monotone(bst, 0, +1), f"{method}: not increasing in x0"
         assert _check_monotone(bst, 1, -1), f"{method}: not decreasing in x1"
 
+    def test_advanced_with_missing_values(self):
+        """NA rows route by default_left regardless of the threshold, so
+        the advanced leaf boxes widen over the NA bin — without that the
+        overlap filter can DROP constraints and violate monotonicity."""
+        x, y = _mono_data(seed=5)
+        rs = np.random.RandomState(5)
+        x = x.copy()
+        x[rs.rand(*x.shape) < 0.1] = np.nan
+        p = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+             "min_data_in_leaf": 10, "monotone_constraints": [1, -1, 0],
+             "monotone_constraints_method": "advanced", "verbosity": -1}
+        bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=25)
+        assert _check_monotone(bst, 0, +1)
+        assert _check_monotone(bst, 1, -1)
+
     def test_advanced_at_least_as_accurate(self):
         """The point of 'advanced' (monotone_constraints.hpp:856): only
         constrain where regions actually interact, recovering gain the
