@@ -206,9 +206,13 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         def _expand(gh, total):
             return gh
 
-    def _hist(binned_view, vals):
+    def _hist(binned_view, vals, slot=None, nslots=1):
+        """Reduced histogram; with ``slot`` a per-slot multi-histogram
+        (split_batch) whose vals ⊗ onehot(slot) expansion happens inside
+        the scan (ops/histogram.py), never as an [N, 3*K] HBM buffer."""
         h = compute_histogram(binned_view, vals, num_bins=Bh,
-                              block_rows=block_rows)
+                              block_rows=block_rows, slot=slot,
+                              num_slots=nslots)
         return reduce_fn(h)
 
     def _make_child_hist(n: int):
@@ -751,12 +755,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 tslot_of_leaf = jnp.full(LP, -1, jnp.int32) \
                     .at[targets].set(jnp.arange(nC, dtype=jnp.int32))
                 tslot = tslot_of_leaf[leaf_of_row]           # [N]
-                onehot_t = (tslot[:, None]
-                            == jnp.arange(nC, dtype=jnp.int32)) \
-                    .astype(vals.dtype)                      # [N, nC]
-                vals_c = (vals[:, :, None] * onehot_t[:, None, :]) \
-                    .reshape(n, 3 * nC)
-                hist_c = _hist(binned_view, vals_c)          # [Fv, Bh, 3nC]
+                hist_c = _hist(binned_view, vals, tslot,
+                               nC)                           # [Fv, Bh, 3nC]
                 hist_c = hist_c.reshape(fv, Bh, 3, nC) \
                     .transpose(3, 0, 1, 2)                   # [nC, Fv, Bh, 3]
                 if use_subtraction:

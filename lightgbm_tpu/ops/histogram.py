@@ -47,13 +47,21 @@ def hist_block_rows(num_features: int, padded_bins: int) -> int:
 
 
 def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
-                      block_rows: int = 0) -> jax.Array:
+                      block_rows: int = 0, slot: Optional[jax.Array] = None,
+                      num_slots: int = 1) -> jax.Array:
     """hist[f, b, c] = sum over rows n of (binned[n,f]==b) * vals[n,c].
 
     binned: [N, F] integer bins (uint8/uint16/int32)
     vals:   [N, C] float32 per-row accumulands (grad, hess, count-weight);
             rows outside the target leaf / bag must already be zeroed.
-    returns [F, num_bins, C] float32.
+    returns [F, num_bins, C] float32 — with ``slot`` set, C becomes
+    ``C * num_slots``.
+
+    slot/num_slots: per-row slot id in [0, num_slots) or negative for
+    "no slot" (row contributes nothing).  The per-slot one-hot expansion
+    ``vals ⊗ onehot(slot)`` is generated INSIDE the row-block scan, so
+    the multi-leaf batched grower never materializes the [N, C*K]
+    operand in HBM (at 10M rows x K=8 that buffer alone would be ~1 GB).
 
     Backend: the XLA one-hot-matmul scan below on every platform.  A
     hand-written Pallas kernel was built and measured SLOWER on TPU v5e
@@ -65,14 +73,18 @@ def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
     the path past that ceiling.
     """
     return _compute_histogram_matmul(binned, vals, num_bins=num_bins,
-                                     block_rows=block_rows)
+                                     block_rows=block_rows, slot=slot,
+                                     num_slots=num_slots)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "block_rows"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "num_slots"))
 def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
-                              num_bins: int, block_rows: int = 0) -> jax.Array:
+                              num_bins: int, block_rows: int = 0,
+                              slot: Optional[jax.Array] = None,
+                              num_slots: int = 1) -> jax.Array:
     n, f = binned.shape
-    c = vals.shape[1]
+    c = vals.shape[1] * (num_slots if slot is not None else 1)
 
     # Pad the bin axis to a multiple of 64 so the [blk, F, Bp] -> [blk, F*Bp]
     # merge is a free relayout (the minor dim tiles onto the 128-lane
@@ -86,18 +98,31 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
         block_rows = hist_block_rows(f, bp)
     block_rows = min(block_rows, max(8, n))
 
+    cv = vals.shape[1]                       # raw (unexpanded) channels
     pad = (-n) % block_rows
     if pad:
         binned = jnp.pad(binned, ((0, pad), (0, 0)))
         vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        if slot is not None:
+            slot = jnp.pad(slot, (0, pad), constant_values=-1)
     nblocks = (n + pad) // block_rows
 
     binned_b = binned.reshape(nblocks, block_rows, f)
-    vals_b = vals.reshape(nblocks, block_rows, c)
+    vals_b = vals.reshape(nblocks, block_rows, cv)
     iota = jnp.arange(bp, dtype=jnp.int32)
+    xs = (binned_b, vals_b)
+    if slot is not None:
+        xs = xs + (slot.reshape(nblocks, block_rows),)
+        kiota = jnp.arange(num_slots, dtype=jnp.int32)
 
     def body(acc, chunk):
-        bins_blk, vals_blk = chunk
+        bins_blk, vals_blk = chunk[0], chunk[1]
+        if slot is not None:
+            # expand vals ⊗ onehot(slot) per block, fused into the scan:
+            # the [N, cv*K] operand never exists in HBM
+            oh_s = (chunk[2][:, None] == kiota).astype(jnp.float32)
+            vals_blk = (vals_blk[:, :, None] * oh_s[:, None, :]) \
+                .reshape(block_rows, c)
         onehot = (bins_blk.astype(jnp.int32)[:, :, None] == iota) \
             .astype(jnp.float32).reshape(block_rows, f * bp)
         # [C, block] x [block, F*Bp] -> [C, F*Bp]: the narrow C=3 axis maps
@@ -110,7 +135,7 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
         return acc + h, None
 
     acc0 = jnp.zeros((c, f * bp), dtype=jnp.float32)
-    acc, _ = lax.scan(body, acc0, (binned_b, vals_b))
+    acc, _ = lax.scan(body, acc0, xs)
     return acc.reshape(c, f, bp).transpose(1, 2, 0)[:, :num_bins, :]
 
 
