@@ -68,6 +68,15 @@ def dataset_set_field(ds, name: str, mv, n: int, dtype: int) -> None:
     elif name in ("group", "query"):
         ds.set_group(arr)
     elif name == "init_score":
+        nrows = ds.num_data if getattr(ds, "num_data", 0) else (
+            ds._raw_input.shape[0]
+            if getattr(ds, "_raw_input", None) is not None
+            and hasattr(ds._raw_input, "shape") else len(arr))
+        if nrows and len(arr) > nrows:
+            # multiclass: the C API ships class-major blocks
+            # ([all rows class 0, all rows class 1, ...], c_api.h);
+            # internal storage is [rows, classes]
+            arr = np.ascontiguousarray(arr.reshape((-1, nrows)).T)
         ds.set_init_score(arr)
     else:
         raise ValueError(f"unknown field {name!r}")
@@ -323,6 +332,38 @@ def booster_refit(bst: Booster, mv, nrow: int, ncol: int, label_mv,
     x = np.frombuffer(mv, np.float64).reshape(int(nrow), int(ncol)).copy()
     label = np.frombuffer(label_mv, np.float32)[:int(nrow)].copy()
     return bst.refit(x, label, decay_rate=float(decay_rate))
+
+
+def dataset_get_field(ds, name: str):
+    """(address, length, type_code) of a metadata field, or length 0 when
+    unset (LGBM_DatasetGetField, c_api.h).  'group' returns the QUERY
+    BOUNDARIES array [num_queries+1] like the reference.  The backing
+    array is pinned on the Dataset so the pointer stays valid until the
+    next GetField call on the same handle."""
+    ds = _as_dataset(ds)
+    ds.construct()
+    md = ds.metadata
+    if name == "label":
+        arr, code = md.label, _F32
+    elif name == "weight":
+        arr, code = md.weight, _F32
+    elif name in ("group", "query"):
+        arr, code = md.query_boundaries, _I32
+    elif name == "init_score":
+        arr, code = md.init_score, _F64
+    else:
+        raise ValueError(f"unknown field {name!r}")
+    if arr is None:
+        # empty field: valid dtype code + null pointer, like the reference
+        return (0, 0, code)
+    arr = np.asarray(arr, _NP_OF[code])
+    if arr.ndim == 2:
+        # multiclass init_score: the C API contract is CLASS-MAJOR
+        # ([all rows class 0, all rows class 1, ...], c_api.h GetField)
+        arr = arr.flatten(order="F")
+    arr = np.ascontiguousarray(arr)
+    ds._field_out = arr            # keep the buffer alive for the caller
+    return (int(arr.ctypes.data), int(arr.size), code)
 
 
 def dataset_save_binary(ds, filename: str) -> None:

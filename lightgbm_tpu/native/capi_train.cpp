@@ -562,6 +562,34 @@ int LGBM_TrainBoosterRefit(BoosterHandle handle, const double* data,
   return 0;
 }
 
+// field_type out: 0 float32, 1 float64, 2 int32, 3 int64 (always a valid
+// code; unset fields report length 0 with a null pointer); 'group' yields
+// the query-boundaries array and multiclass init_score is class-major,
+// both per reference GetField semantics.  The buffer belongs to the
+// dataset handle and stays valid until the next GetField.
+int LGBM_TrainDatasetGetField(DatasetHandle handle, const char* field_name,
+                              int* out_len, const void** out_ptr,
+                              int* out_type) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),
+                                 field_name);
+  PyObject* r = Call("dataset_get_field", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  unsigned long long addr = 0;
+  long long len = 0;
+  int code = -1;
+  if (!PyArg_ParseTuple(r, "KLi", &addr, &len, &code)) {
+    Py_DECREF(r);
+    return PyError();
+  }
+  Py_DECREF(r);
+  *out_ptr = reinterpret_cast<const void*>(static_cast<uintptr_t>(addr));
+  *out_len = static_cast<int>(len);
+  *out_type = code;
+  return 0;
+}
+
 int LGBM_TrainDatasetSaveBinary(DatasetHandle handle, const char* filename) {
   Gil gil;
   PyObject* args = Py_BuildValue("(Os)", reinterpret_cast<PyObject*>(handle),

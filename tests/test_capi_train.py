@@ -448,3 +448,47 @@ def test_dump_refit_binary_and_feature_names(tmp_path):
     lib.LGBM_TrainBoosterFree(bst)
     lib.LGBM_TrainBoosterFree(b2)
     lib.LGBM_TrainDatasetFree(ds)
+
+
+def test_get_field_roundtrip():
+    lib = _lib()
+    x, y = _data(n=300, f=4, seed=8)
+    w = np.abs(np.random.RandomState(8).randn(300)).astype(np.float32)
+    ds = ctypes.c_void_p()
+    assert lib.LGBM_TrainDatasetCreateFromMat(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 300, 4,
+        b"max_bin=31 verbosity=-1", None, ctypes.byref(ds)) == 0
+    assert lib.LGBM_TrainDatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0) == 0
+    assert lib.LGBM_TrainDatasetSetField(
+        ds, b"weight", w.ctypes.data_as(ctypes.c_void_p), 300, 0) == 0
+
+    out_len = ctypes.c_int()
+    out_ptr = ctypes.c_void_p()
+    out_type = ctypes.c_int()
+    rc = lib.LGBM_TrainDatasetGetField(
+        ds, b"label", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type))
+    assert rc == 0, lib.LGBM_TrainGetLastError()
+    assert out_len.value == 300 and out_type.value == 0
+    got = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_float)), (300,))
+    np.testing.assert_array_equal(got, y)
+
+    assert lib.LGBM_TrainDatasetGetField(
+        ds, b"weight", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)) == 0
+    got_w = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_float)), (300,))
+    np.testing.assert_array_equal(got_w, w)
+
+    # unset field -> length 0 with a VALID dtype code (reference behavior)
+    assert lib.LGBM_TrainDatasetGetField(
+        ds, b"init_score", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)) == 0
+    assert out_len.value == 0 and out_type.value == 1
+    # unknown field -> error
+    assert lib.LGBM_TrainDatasetGetField(
+        ds, b"nonsense", ctypes.byref(out_len), ctypes.byref(out_ptr),
+        ctypes.byref(out_type)) == -1
+    lib.LGBM_TrainDatasetFree(ds)
