@@ -7,21 +7,28 @@ docs/GPU-Performance.rst:108-124).
 
 Primary metric (round-over-round comparable): steady-state iters/s on a
 1M-row slice at 31 leaves / 63 bins; ``vs_baseline`` is against the
-reference's full-size 3.843 iters/s.  ``extra`` carries the baseline-shaped
-points VERDICT r2 asked for: a 255-leaf run and a 10M-row scaling point.
+reference's full-size 3.843 iters/s.  ``extra`` carries the
+baseline-shaped points: strict leaf-wise growth, a 255-leaf run (the
+baseline's own tree shape), a 10M-row scaling point, and an
+Epsilon-shaped wide point (400k x 2000 dense, GPU-Performance.rst:63).
 
-Round-3 perf notes (PROFILE.md): training runs in fused on-device chunks
-(lax.scan over whole iterations, one host sync per chunk — the tunneled
-chip costs ~67 ms per blocking call), and the histogram kernel uses the
-[C, rows] x [rows, F*Bp] orientation with a lane-aligned bin axis.
-Round-2's bench also silently binned at 255 bins (Dataset() without
-params); params are now passed to the Dataset constructor.
+Capture discipline (VERDICT r3 task 1 — a perf round whose number can't
+be captured is a failed perf round):
 
-Robustness: the measurement runs in a CHILD process; the parent retries
-with backoff on failure (shrinking timeouts — an unbounded retry ladder
-can eat the round's budget, ADVICE r2), falls back to a reduced CPU run as
-a last resort, and ALWAYS prints exactly one JSON line
-{"metric", "value", "unit", "vs_baseline"[, "extra"][, "error"]}.
+- The parent first PROBES the TPU claim in a disposable child (the axon
+  tunnel is exclusive and can wedge: a killed mid-claim process blocks
+  every later ``jax.devices()`` for hours).  A hung probe is diagnosed
+  as a wedge and the parent goes STRAIGHT to the CPU fallback instead of
+  burning the round's budget on retries that cannot succeed.
+- The primary point runs in a child with a HARD 600 s budget; one quick
+  retry (300 s) and then the CPU fallback.  Extras run in a SEPARATE
+  child afterwards that can die without losing the primary.
+- Every measured point is appended to ``BENCH_POINTS.jsonl`` (next to
+  this file) the moment it lands, and the primary metric line is printed
+  to stdout immediately — a timeout kill loses at most the point in
+  flight.  The parent merges file + partial stdout and always emits
+  exactly ONE final JSON line {"metric", "value", "unit",
+  "vs_baseline"[, "extra"][, "error"]}.
 """
 
 import json
@@ -37,12 +44,46 @@ METRIC = "higgs1m_binary_train_iters_per_sec"
 N_ROWS, N_FEAT = 1_000_000, 28
 PRIMARY_LEAVES, PRIMARY_MAX_BIN = 31, 63
 PRIMARY_PADDED_BIN = 64          # ops/histogram.py pads the bin axis to 64
+POINTS_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_POINTS.jsonl")
+
+PROBE_TIMEOUT = 150              # healthy claims take ~0.1 s (BENCH_r02)
+PRIMARY_TIMEOUT = 600            # hard cap, VERDICT r3 task 1
+QUICK_TIMEOUT = 300
+EXTRAS_TIMEOUT = 600
+CPU_TIMEOUT = 420
 
 # bf16/f32 MXU peak per chip for MFU estimate; unknown kinds report FLOP/s.
 PEAK_FLOPS = {
     "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12,
     "v4": 275e12, "v6e": 918e12, "v6lite": 918e12,
 }
+
+
+def _record_point(name, **kv):
+    """Append one measured point to the results file IMMEDIATELY (crash /
+    timeout safe) and mirror it to stderr for the log tail."""
+    rec = {"point": name, **kv}
+    try:
+        with open(POINTS_FILE, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        print(f"[bench] point-file write failed: {e}", file=sys.stderr)
+    print(f"[bench] point {rec}", file=sys.stderr, flush=True)
+
+
+def _peak_for(devs):
+    """MXU peak FLOP/s for the claimed device kind, or None if unknown."""
+    kind = devs[0].device_kind.lower().replace(" ", "")
+    return next((v for k, v in PEAK_FLOPS.items() if k in kind), None)
+
+
+def _hist_flops_per_iter(n: int, leaves: int) -> float:
+    """Useful histogram FLOPs per boosting iteration (one-hot
+    contraction, (leaves-1) smaller-child passes)."""
+    return 2.0 * 3 * n * N_FEAT * PRIMARY_PADDED_BIN * (leaves - 1)
 
 
 def make_higgs_like(n: int, f: int, seed: int = 0):
@@ -54,17 +95,32 @@ def make_higgs_like(n: int, f: int, seed: int = 0):
     return x, y
 
 
+def make_epsilon_like(n: int, f: int, seed: int = 3):
+    """Epsilon-shaped wide dense data (400k x 2000), generated in f32
+    row-chunks so the host never holds an f64 copy (~6.4 GB)."""
+    rng = np.random.RandomState(seed)
+    x = np.empty((n, f), dtype=np.float32)
+    chunk = max(1, 50_000_000 // f)
+    for lo in range(0, n, chunk):
+        hi = min(n, lo + chunk)
+        x[lo:hi] = rng.standard_normal((hi - lo, f)).astype(np.float32)
+    w = rng.standard_normal(16).astype(np.float32)
+    logit = x[:, :16] @ w + 0.5 * rng.standard_normal(n).astype(np.float32)
+    y = (logit > 0).astype(np.float32)
+    return x, y
+
+
 def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
-                 split_batch=0):
+                 split_batch=0, max_bin=PRIMARY_MAX_BIN):
     """Train one config; returns (ips, auc, ds) steady-state over n_chunks
     fused chunks (or per-iter updates when fusion is unavailable).  Pass
     ``ds`` to reuse an already-binned dataset (num_leaves is a Booster
     param; binning is identical across points on the same data).
-    split_batch: 0 = config auto (strict below 64 leaves, 8-way above),
+    split_batch: 0 = config auto (strict below 64 leaves, batched above),
     explicit K pins the grower's super-step width (grower.py)."""
     params = {
         "objective": "binary", "num_leaves": num_leaves,
-        "learning_rate": 0.1, "max_bin": PRIMARY_MAX_BIN,
+        "learning_rate": 0.1, "max_bin": max_bin,
         "min_data_in_leaf": 20, "verbosity": 0,
         "split_batch": split_batch,
     }
@@ -111,15 +167,12 @@ def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
     return ips, auc, ds
 
 
-def child() -> None:
-    """The actual measurement; prints the JSON line on success."""
-    quick = os.environ.get("_BENCH_QUICK") == "1"
-
+def _claim_device(cpu: bool):
     print("[bench] importing jax / claiming device...", file=sys.stderr,
           flush=True)
     t_dev = time.time()
     import jax
-    if os.environ.get("_BENCH_CPU") == "1":
+    if cpu:
         # in-process override, NOT the JAX_PLATFORMS env var: the axon
         # sitecustomize pins the platform config at interpreter start, so
         # the env var is ignored and jax.devices() would still try to
@@ -129,9 +182,24 @@ def child() -> None:
     devs = jax.devices()
     print(f"[bench] devices={devs} ({time.time() - t_dev:.1f}s)",
           file=sys.stderr, flush=True)
+    return devs
+
+
+def child_probe() -> None:
+    """Disposable TPU-claim probe: prints a marker line on success."""
+    devs = _claim_device(cpu=False)
+    print(f"PROBE_OK {devs[0].device_kind}", flush=True)
+
+
+def child_primary() -> None:
+    """The primary measurement; prints the JSON metric line ASAP."""
+    quick = os.environ.get("_BENCH_QUICK") == "1"
+    cpu = os.environ.get("_BENCH_CPU") == "1"
+    devs = _claim_device(cpu=cpu)
     import lightgbm_tpu as lgb
 
-    x, y = make_higgs_like(N_ROWS, N_FEAT)
+    n = N_ROWS if not cpu else N_ROWS // 10
+    x, y = make_higgs_like(n, N_FEAT)
 
     # primary: 1M x 28, 31 leaves, 8-way batched super-steps (the
     # framework's fast growth mode; AUC reported alongside so quality is
@@ -140,7 +208,6 @@ def child() -> None:
                                    chunk=4 if quick else 25,
                                    n_chunks=1 if quick else 4,
                                    tag="1M/31leaf/sb8", split_batch=8)
-
     rec = {
         "metric": METRIC,
         "value": round(ips1, 3),
@@ -148,14 +215,22 @@ def child() -> None:
                  "split_batch=8)"),
         "vs_baseline": round(ips1 / BASELINE_IPS, 3),
     }
-    # emit the primary record NOW: if an extra point wedges and the parent
-    # kills this child, the partial-stdout scan still recovers the primary
-    # (the parent takes the LAST matching line, so a later enriched record
-    # supersedes this one)
+    if cpu:
+        rec["unit"] += f" [CPU fallback, {n} rows]"
+    # persist + emit the primary record NOW: a later timeout kill (or a
+    # hang in the strict point) must not discard it
+    _record_point("primary", auc=round(float(auc1), 4), cpu=cpu, **rec)
     print(json.dumps(rec), flush=True)
 
-    extra = {"higgs1m_31leaf_sb8_auc": round(float(auc1), 4)}
-    if not quick:
+    # observability: achieved histogram FLOP/s + MFU estimate
+    achieved = _hist_flops_per_iter(n, PRIMARY_LEAVES) * ips1
+    peak = _peak_for(devs)
+    mfu = f"{achieved / peak:.1%}" if peak else "n/a"
+    print(f"[bench] primary {ips1:.2f} iters/s train-AUC={auc1:.4f} "
+          f"hist~{achieved / 1e12:.2f} TFLOP/s (MFU~{mfu} of "
+          f"{devs[0].device_kind})", file=sys.stderr, flush=True)
+
+    if not quick and not cpu:
         # strict leaf-wise growth (split_batch=1): round-over-round
         # comparable with BENCH_r02/r03 history + the AUC quality anchor
         try:
@@ -164,59 +239,73 @@ def child() -> None:
                                          chunk=25, n_chunks=2,
                                          tag="1M/31leaf/strict", ds=ds1,
                                          split_batch=1)
-            extra["higgs1m_31leaf_strict_iters_per_sec"] = round(ips0, 3)
-            extra["higgs1m_31leaf_strict_auc"] = round(float(auc0), 4)
+            _record_point("higgs1m_31leaf_strict", value=round(ips0, 3),
+                          auc=round(float(auc0), 4))
         except Exception as e:
-            extra["higgs1m_strict_error"] = f"{type(e).__name__}: {e}"[:200]
-        # VERDICT r2 task 3a: the baseline's 255-leaf shape (at 1M rows)
-        try:
-            ips2, auc2, _ = _train_point(lgb, x, y, num_leaves=255, chunk=4,
-                                         n_chunks=2, tag="1M/255leaf",
-                                         ds=ds1)
-            extra["higgs1m_255leaf_iters_per_sec"] = round(ips2, 3)
-            extra["higgs1m_255leaf_auc"] = round(float(auc2), 4)
-        except Exception as e:       # keep the primary JSON alive
-            extra["higgs1m_255leaf_error"] = f"{type(e).__name__}: {e}"[:200]
-        # VERDICT r2 task 3b: 10M-row scaling point (31 leaves)
-        try:
-            x10 = np.concatenate([x] * 10, axis=0)
-            rng = np.random.RandomState(7)
-            for i in range(10):     # chunked f32 noise: no 2 GB f64 spike
-                sl = slice(i * N_ROWS, (i + 1) * N_ROWS)
-                x10[sl] += (rng.standard_normal(
-                    (N_ROWS, N_FEAT)).astype(np.float32) * 1e-3)
-            y10 = np.concatenate([y] * 10)
-            ips3, auc3, _ = _train_point(lgb, x10, y10, num_leaves=31,
-                                         chunk=8, n_chunks=2,
-                                         tag="10M/31leaf/sb8",
-                                         split_batch=8)
-            extra["higgs10m_iters_per_sec"] = round(ips3, 3)
-            extra["higgs10m_auc"] = round(float(auc3), 4)
-        except Exception as e:
-            extra["higgs10m_error"] = f"{type(e).__name__}: {e}"[:200]
-
-    # observability: achieved histogram FLOP/s + MFU estimate for the
-    # primary point (one-hot contraction, (num_leaves-1) passes/iter)
-    hist_flops = (2.0 * 3 * N_ROWS * N_FEAT * PRIMARY_PADDED_BIN
-                  * (PRIMARY_LEAVES - 1))
-    achieved = hist_flops * ips1
-    kind = devs[0].device_kind.lower().replace(" ", "")
-    peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)
-    mfu = f"{achieved / peak:.1%}" if peak else "n/a"
-    print(f"[bench] primary {ips1:.2f} iters/s train-AUC={auc1:.4f} "
-          f"hist~{achieved / 1e12:.2f} TFLOP/s (MFU~{mfu} of "
-          f"{devs[0].device_kind})", file=sys.stderr)
-
-    if extra:
-        if "higgs1m_255leaf_iters_per_sec" in extra:
-            extra["higgs1m_255leaf_vs_baseline"] = round(
-                extra["higgs1m_255leaf_iters_per_sec"] / BASELINE_IPS, 3)
-        rec["extra"] = extra
-        print(json.dumps(rec), flush=True)
+            _record_point("higgs1m_31leaf_strict",
+                          error=f"{type(e).__name__}: {e}"[:200])
 
 
-def _last_metric_line(stdout: str):
-    """Last (most-enriched) JSON metric line, or None."""
+def child_extras() -> None:
+    """The non-primary points, each persisted as it lands.  Runs in its
+    own child AFTER the primary is safe; a wedge/timeout here costs only
+    the points not yet reached."""
+    devs = _claim_device(cpu=os.environ.get("_BENCH_CPU") == "1")
+    import lightgbm_tpu as lgb
+
+    x, y = make_higgs_like(N_ROWS, N_FEAT)
+
+    # the baseline's own 255-leaf tree shape (VERDICT r2 task 3a; the
+    # vs_baseline that matters most — 3.843 iters/s IS this shape).
+    # auto split_batch=16 -> M=3K=48 of the MXU's 128 rows; the achieved
+    # histogram FLOP/s double as the MFU evidence for VERDICT r3 task 3.
+    try:
+        ips2, auc2, _ = _train_point(lgb, x, y, num_leaves=255, chunk=4,
+                                     n_chunks=2, tag="1M/255leaf")
+        flops = _hist_flops_per_iter(N_ROWS, 255) * ips2
+        peak = _peak_for(devs)
+        _record_point("higgs1m_255leaf", value=round(ips2, 3),
+                      auc=round(float(auc2), 4),
+                      vs_baseline=round(ips2 / BASELINE_IPS, 3),
+                      hist_tflops=round(flops / 1e12, 2),
+                      mfu=round(flops / peak, 4) if peak else None)
+    except Exception as e:
+        _record_point("higgs1m_255leaf",
+                      error=f"{type(e).__name__}: {e}"[:200])
+
+    # Epsilon-shaped wide point (VERDICT r3 task 6: 400k x 2000 dense)
+    try:
+        xe, ye = make_epsilon_like(400_000, 2000)
+        ipse, auce, _ = _train_point(lgb, xe, ye, num_leaves=PRIMARY_LEAVES,
+                                     chunk=4, n_chunks=2,
+                                     tag="400k/2000f/31leaf", split_batch=8)
+        _record_point("epsilon400k_2000f", value=round(ipse, 3),
+                      auc=round(float(auce), 4))
+        del xe, ye
+    except Exception as e:
+        _record_point("epsilon400k_2000f",
+                      error=f"{type(e).__name__}: {e}"[:200])
+
+    # 10M-row scaling point (VERDICT r2 task 3b)
+    try:
+        x10 = np.concatenate([x] * 10, axis=0)
+        rng = np.random.RandomState(7)
+        for i in range(10):     # chunked f32 noise: no 2 GB f64 spike
+            sl = slice(i * N_ROWS, (i + 1) * N_ROWS)
+            x10[sl] += (rng.standard_normal(
+                (N_ROWS, N_FEAT)).astype(np.float32) * 1e-3)
+        y10 = np.concatenate([y] * 10)
+        ips3, auc3, _ = _train_point(lgb, x10, y10, num_leaves=31,
+                                     chunk=8, n_chunks=2,
+                                     tag="10M/31leaf/sb8", split_batch=8)
+        _record_point("higgs10m", value=round(ips3, 3),
+                      auc=round(float(auc3), 4))
+    except Exception as e:
+        _record_point("higgs10m", error=f"{type(e).__name__}: {e}"[:200])
+
+
+def _metric_line(stdout: str):
+    """Last JSON metric line in a (possibly partial) stdout, or None."""
     found = None
     for line in (stdout or "").splitlines():
         line = line.strip()
@@ -225,68 +314,159 @@ def _last_metric_line(stdout: str):
     return found
 
 
-def run_child(extra_env, timeout: int):
-    env = dict(os.environ, _BENCH_CHILD="1")
-    env.update(extra_env)
+def run_child(mode: str, timeout: int, extra_env=None, orphan=False):
+    """Run one child; returns (stdout_text, err_summary).
+
+    orphan=True (the probe): on timeout the child is LEFT RUNNING, not
+    killed — SIGKILLing a client mid-TPU-claim is exactly what wedges
+    the axon relay ('grant unclaimed past timeout'); an orphan that
+    eventually gets the grant exits cleanly a moment later and releases
+    it, merely delaying (not breaking) the next claimer."""
+    env = dict(os.environ, _BENCH_CHILD=mode)
+    env.update(extra_env or {})
+    out_f = open(POINTS_FILE + f".{mode}.out", "w+")
+    err_f = open(POINTS_FILE + f".{mode}.err", "w+")
+    p = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                         env=env, stdout=out_f, stderr=err_f, text=True)
     try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           env=env, capture_output=True, text=True,
-                           timeout=timeout)
-    except subprocess.TimeoutExpired as e:
-        def _txt(b):
-            return (b.decode(errors="replace") if isinstance(b, bytes)
-                    else (b or ""))
-        sys.stderr.write(_txt(e.stderr)[-2000:])
-        # the child prints the primary record before the optional extra
-        # points — a hang in an extra must not discard the primary
-        line = _last_metric_line(_txt(e.stdout))
-        if line:
-            return line, None
-        return None, f"timeout after {timeout}s"
-    sys.stderr.write(r.stderr[-4000:] if r.stderr else "")
-    line = _last_metric_line(r.stdout)
-    if line:
-        return line, None
-    return None, f"rc={r.returncode}, no JSON line"
+        p.wait(timeout=timeout)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        if not orphan:
+            p.kill()
+            p.wait()
+
+    def _read(f):
+        f.flush()
+        f.seek(0)
+        return f.read()
+    out, err_txt = _read(out_f), _read(err_f)
+    out_f.close()
+    err_f.close()
+    sys.stderr.write(err_txt[-4000:])
+    if timed_out:
+        return out, f"timeout after {timeout}s" + \
+            (" (left running, not killed mid-claim)" if orphan else "")
+    err = None if p.returncode == 0 else f"rc={p.returncode}"
+    return out, err
+
+
+def _read_points():
+    pts = []
+    try:
+        with open(POINTS_FILE) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        pts.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return pts
 
 
 def main():
-    if os.environ.get("_BENCH_CHILD"):
-        child()
+    mode = os.environ.get("_BENCH_CHILD")
+    if mode:
+        {"probe": child_probe, "primary": child_primary,
+         "extras": child_extras}[mode]()
         return
+
+    # fresh points file per run; the old one is superseded
+    try:
+        os.replace(POINTS_FILE, POINTS_FILE + ".prev")
+    except OSError:
+        pass
+    _record_point("run_start", t=time.strftime("%Y-%m-%dT%H:%M:%S"))
 
     errors = []
-    # shrinking timeouts (ADVICE r2: a fixed 2400s ladder could eat the
-    # round's budget); later attempts drop the extra points via _BENCH_QUICK
-    for attempt, (backoff, timeout, env) in enumerate((
-            (0, 2400, {}),
-            (20, 1200, {"_BENCH_QUICK": "1"}),
-            (60, 900, {"_BENCH_QUICK": "1"}))):
-        if backoff:
-            print(f"[bench] retrying in {backoff}s...", file=sys.stderr,
-                  flush=True)
-            time.sleep(backoff)
-        line, err = run_child(env, timeout=timeout)
-        if line:
-            print(line, flush=True)
-            return
-        errors.append(f"attempt{attempt + 1}: {err}")
-        print(f"[bench] attempt {attempt + 1} failed: {err}", file=sys.stderr,
+    # --- 1. probe the TPU claim (wedge detection, see module docstring) --
+    tpu_ok = False
+    for i in range(2):
+        t0 = time.time()
+        out, err = run_child("probe", timeout=PROBE_TIMEOUT, orphan=True)
+        if "PROBE_OK" in (out or ""):
+            tpu_ok = True
+            break
+        diag = ("wedged: claim hung (timeout-killed client holds the "
+                "relay grant)" if err and "timeout" in err
+                else f"claim failed fast ({err}) after "
+                     f"{time.time() - t0:.0f}s")
+        errors.append(f"probe{i + 1}: {diag}")
+        print(f"[bench] TPU probe {i + 1} failed: {diag}", file=sys.stderr,
               flush=True)
+        if err and "timeout" in err:
+            break                    # a wedge does not clear in 30 s
+        time.sleep(30)               # fast Unavailable may be transient
+    _record_point("probe", tpu_ok=tpu_ok, errors=errors[:])
 
-    # last resort: reduced CPU run — an honest degraded number beats none
-    line, err = run_child({"_BENCH_CPU": "1", "_BENCH_QUICK": "1"},
-                          timeout=600)
-    if line:
-        rec = json.loads(line)
-        rec["error"] = ("degraded: accelerator unavailable, CPU fallback; "
+    # --- 2. primary point (hard-capped) ---------------------------------
+    line = None
+    if tpu_ok:
+        out, err = run_child("primary", timeout=PRIMARY_TIMEOUT)
+        line = _metric_line(out)
+        if not line:
+            errors.append(f"primary: {err or 'no JSON line'}")
+            print("[bench] primary failed; quick retry...", file=sys.stderr,
+                  flush=True)
+            out, err = run_child("primary", timeout=QUICK_TIMEOUT,
+                                 extra_env={"_BENCH_QUICK": "1"})
+            line = _metric_line(out)
+            if not line:
+                errors.append(f"primary-quick: {err or 'no JSON line'}")
+    degraded = None
+    if not line:
+        # last resort: reduced CPU run — an honest degraded number beats
+        # none (and records the wedge diagnosis machine-readably)
+        out, err = run_child("primary", timeout=CPU_TIMEOUT,
+                             extra_env={"_BENCH_CPU": "1",
+                                        "_BENCH_QUICK": "1"})
+        line = _metric_line(out)
+        if line:
+            degraded = ("degraded: accelerator unavailable, CPU fallback; "
                         + "; ".join(errors))
+        else:
+            errors.append(f"cpu-fallback: {err or 'no JSON line'}")
+
+    # --- 3. extras in their own killable child --------------------------
+    # only when the TPU primary itself succeeded: a degraded CPU capture
+    # means the TPU path is broken and another 600 s child would burn
+    # the budget the capture discipline exists to protect
+    if line and tpu_ok and not degraded:
+        run_child("extras", timeout=EXTRAS_TIMEOUT)
+
+    # --- 4. merge + emit exactly one line -------------------------------
+    if not line:
+        rec = {"metric": METRIC, "value": 0.0, "unit": "iters/s",
+               "vs_baseline": 0.0, "error": "; ".join(errors)}
+        _record_point("final", **rec)
         print(json.dumps(rec), flush=True)
         return
-    errors.append(f"cpu-fallback: {err}")
-    print(json.dumps({
-        "metric": METRIC, "value": 0.0, "unit": "iters/s",
-        "vs_baseline": 0.0, "error": "; ".join(errors)}), flush=True)
+    rec = json.loads(line)
+    extra = {}
+    for p in _read_points():
+        name = p.get("point")
+        if name in (None, "run_start", "probe", "final", "primary"):
+            if name == "primary" and "auc" in p:
+                extra["higgs1m_31leaf_sb8_auc"] = p["auc"]
+            continue
+        if "value" in p:
+            extra[name + "_iters_per_sec"] = p["value"]
+            if "auc" in p:
+                extra[name + "_auc"] = p["auc"]
+            if "vs_baseline" in p:
+                extra[name + "_vs_baseline"] = p["vs_baseline"]
+        elif "error" in p:
+            extra[name + "_error"] = p["error"]
+    if extra:
+        rec["extra"] = extra
+    if degraded:
+        rec["error"] = degraded
+    _record_point("final", **rec)
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":
