@@ -47,6 +47,17 @@ def dataset_create_from_file(path: str, params: str,
                              reference: Optional[Dataset] = None) -> Dataset:
     from .data_io import load_text
     p = _params(params)
+    # binary dataset cache (the reference detects its binary magic the
+    # same way, dataset_loader.cpp LoadFromBinFile): the npz container
+    # starts with the zip magic
+    real = path if os.path.exists(path) else (
+        path + ".npz" if os.path.exists(path + ".npz") else path)
+    try:
+        with open(real, "rb") as f:
+            if f.read(2) == b"PK":
+                return Dataset.load_binary(real)
+    except OSError:
+        pass
     x, y = load_text(path, has_header=str(p.get("header", "")).lower()
                      in ("true", "1"),
                      label_column=str(p.get("label_column", "")))
@@ -142,17 +153,27 @@ def booster_get_eval(bst: Booster) -> str:
     return "\n".join(f"{dn}\t{mn}\t{val!r}" for dn, mn, val, _ in rows)
 
 
+def _predict_dispatch(bst: Booster, x, predict_type: int,
+                      start_iteration: int, num_iteration: int) -> np.ndarray:
+    """predict_type: 0 normal, 1 raw, 2 leaf index, 3 contrib
+    (C_API_PREDICT_* values, c_api.h:527-535) — the single dispatch used
+    by every C prediction entry point."""
+    num = int(num_iteration) if int(num_iteration) > 0 else None
+    kw = dict(start_iteration=int(start_iteration), num_iteration=num)
+    predict_type = int(predict_type)
+    if predict_type == 2:
+        res = bst.predict(x, pred_leaf=True, **kw)
+    elif predict_type == 3:
+        res = bst.predict(x, pred_contrib=True, **kw)
+    else:
+        res = bst.predict(x, raw_score=(predict_type == 1), **kw)
+    return np.asarray(res, np.float64)
+
+
 def _predict_out(bst: Booster, x, predict_type: int, start_iteration: int,
                  num_iteration: int, out_mv) -> int:
-    num = num_iteration if num_iteration > 0 else None
-    kw = dict(start_iteration=int(start_iteration), num_iteration=num)
-    if predict_type == 2:
-        res = bst.predict(x, pred_leaf=True, **kw).astype(np.float64)
-    elif predict_type == 3:
-        res = bst.predict(x, pred_contrib=True, **kw).astype(np.float64)
-    else:
-        res = bst.predict(x, raw_score=(predict_type == 1),
-                          **kw).astype(np.float64)
+    res = _predict_dispatch(bst, x, predict_type, start_iteration,
+                            num_iteration)
     flat = np.ascontiguousarray(res).reshape(-1)
     out = np.frombuffer(out_mv, np.float64)
     if len(flat) > len(out):
@@ -443,3 +464,173 @@ def network_free() -> None:
         jax.distributed.shutdown()
     except RuntimeError:
         pass  # never initialized
+
+
+# ---------------------------------------------------------------------------
+# Reference-exact ABI adapters (VERDICT r3 task 5): the typed/positional
+# variants the reference's own c_api.h prototypes use (c_api.h:109,203,
+# 248,272,472,567,701,749,1072,1141-1199,1220), driven by the LGBM_*-named
+# exports in native/capi_train.cpp so reference bindings and apps link
+# against libcapi_train.so unmodified.
+# ---------------------------------------------------------------------------
+
+def _typed_matrix(mv, data_type: int, nrow: int, ncol: int,
+                  is_row_major: int) -> np.ndarray:
+    dt = _NP_OF[int(data_type)]
+    arr = np.frombuffer(mv, dt)[:int(nrow) * int(ncol)]
+    if int(is_row_major):
+        arr = arr.reshape(int(nrow), int(ncol))
+    else:
+        arr = arr.reshape(int(ncol), int(nrow)).T
+    return np.array(arr, np.float64, copy=True, order="C")
+
+
+def dataset_create_from_mat2(mv, data_type: int, nrow: int, ncol: int,
+                             is_row_major: int, params: str,
+                             reference=None) -> Dataset:
+    return Dataset(_typed_matrix(mv, data_type, nrow, ncol, is_row_major),
+                   params=_params(params), reference=_as_dataset(reference)
+                   if reference is not None else None)
+
+
+def _typed_sparse_parts(indptr_mv, indptr_type, n_indptr, indices_mv,
+                        data_mv, data_type, nelem):
+    indptr = np.frombuffer(indptr_mv,
+                           _NP_OF[int(indptr_type)])[:int(n_indptr)]
+    indices = np.frombuffer(indices_mv, np.int32)[:int(nelem)]
+    data = np.frombuffer(data_mv, _NP_OF[int(data_type)])[:int(nelem)]
+    return (indptr.astype(np.int64), indices.copy(),
+            data.astype(np.float64))
+
+
+def dataset_create_from_csr2(indptr_mv, indptr_type, indices_mv, data_mv,
+                             data_type, n_indptr, nelem, ncol, params: str,
+                             reference=None) -> Dataset:
+    from scipy.sparse import csr_matrix
+    indptr, indices, data = _typed_sparse_parts(
+        indptr_mv, indptr_type, n_indptr, indices_mv, data_mv, data_type,
+        nelem)
+    mat = csr_matrix((data, indices, indptr),
+                     shape=(int(n_indptr) - 1, int(ncol)))
+    return Dataset(mat, params=_params(params),
+                   reference=_as_dataset(reference)
+                   if reference is not None else None)
+
+
+def dataset_create_from_csc2(colptr_mv, colptr_type, indices_mv, data_mv,
+                             data_type, ncol_ptr, nelem, nrow, params: str,
+                             reference=None) -> Dataset:
+    from scipy.sparse import csc_matrix
+    colptr, indices, data = _typed_sparse_parts(
+        colptr_mv, colptr_type, ncol_ptr, indices_mv, data_mv, data_type,
+        nelem)
+    mat = csc_matrix((data, indices, colptr),
+                     shape=(int(nrow), int(ncol_ptr) - 1))
+    return Dataset(mat, params=_params(params),
+                   reference=_as_dataset(reference)
+                   if reference is not None else None)
+
+
+def booster_num_total_model(bst: Booster) -> int:
+    return int(len(bst.trees))
+
+
+def booster_num_model_per_iteration(bst: Booster) -> int:
+    return int(bst._num_tree_per_iteration)
+
+
+def booster_get_eval_counts(bst: Booster) -> int:
+    return len(booster_get_eval_names(bst).split("\t")) \
+        if booster_get_eval_names(bst) else 0
+
+
+def booster_get_eval_values(bst: Booster, data_idx: int, out_mv) -> int:
+    """LGBM_BoosterGetEval (c_api.h:701): data_idx 0 = training data,
+    i >= 1 = (i-1)-th validation set; one double per eval metric."""
+    if int(data_idx) == 0:
+        rows = bst.eval_train()
+    else:
+        names = bst._valid_names
+        i = int(data_idx) - 1
+        if i >= len(names):
+            raise ValueError(f"data_idx {data_idx} out of range "
+                             f"({len(names)} validation sets)")
+        rows = [r for r in bst.eval_valid() if r[0] == names[i]]
+    vals = np.asarray([v for _, _, v, _ in rows], np.float64)
+    out = np.frombuffer(out_mv, np.float64)
+    if len(vals) > len(out):
+        raise ValueError("output buffer too small")
+    out[:len(vals)] = vals
+    return int(len(vals))
+
+
+def booster_predict_mat2(bst: Booster, mv, data_type: int, nrow: int,
+                         ncol: int, is_row_major: int, predict_type: int,
+                         start_iteration: int, num_iteration: int,
+                         out_mv) -> int:
+    x = _typed_matrix(mv, data_type, nrow, ncol, is_row_major)
+    return _predict_out(bst, x, predict_type, start_iteration,
+                        num_iteration, out_mv)
+
+
+def booster_predict_csr2(bst: Booster, indptr_mv, indptr_type, indices_mv,
+                         data_mv, data_type, n_indptr, nelem, ncol,
+                         predict_type: int, start_iteration: int,
+                         num_iteration: int, out_mv) -> int:
+    from scipy.sparse import csr_matrix
+    indptr, indices, data = _typed_sparse_parts(
+        indptr_mv, indptr_type, n_indptr, indices_mv, data_mv, data_type,
+        nelem)
+    x = csr_matrix((data, indices, indptr),
+                   shape=(int(n_indptr) - 1, int(ncol)))
+    return _predict_out(bst, x, predict_type, start_iteration,
+                        num_iteration, out_mv)
+
+
+def booster_predict_for_file(bst: Booster, data_filename: str,
+                             has_header: int, predict_type: int,
+                             start_iteration: int, num_iteration: int,
+                             result_filename: str) -> None:
+    """LGBM_BoosterPredictForFile (c_api.h:749): text rows follow the
+    training convention (label in the first column unless the width
+    already matches the model)."""
+    from .data_io import load_text
+    x, y = load_text(data_filename, has_header=bool(int(has_header)))
+    nf = bst.num_feature()
+    if x.shape[1] == nf - 1 and y is not None:
+        # the file had NO label column: load_text treated feature 0 as
+        # the label — put it back
+        x = np.column_stack([y, x])
+    res = np.atleast_1d(_predict_dispatch(bst, x, predict_type,
+                                          start_iteration, num_iteration))
+    with open(result_filename, "w") as f:
+        if res.ndim == 1:
+            for v in res:
+                f.write(f"{v:.17g}\n")
+        else:
+            for row in res:
+                f.write("\t".join(f"{v:.17g}" for v in row) + "\n")
+
+
+def booster_add_valid_auto(bst: Booster, ds) -> None:
+    booster_add_valid(bst, ds, f"valid_{len(bst._valid_names)}")
+
+
+def booster_update_custom(bst: Booster, grad_mv, hess_mv, n: int) -> int:
+    g = np.frombuffer(grad_mv, np.float32)[:int(n)].copy()
+    h = np.frombuffer(hess_mv, np.float32)[:int(n)].copy()
+    nc = int(bst._model.num_class)
+    if nc > 1:
+        # the C contract ships class-major blocks ([all rows class 0,
+        # all rows class 1, ...], c_api.h:589); internal layout is
+        # [rows, classes]
+        nd = int(bst._model.num_data)
+        g = np.ascontiguousarray(g.reshape(nc, nd).T)
+        h = np.ascontiguousarray(h.reshape(nc, nd).T)
+    return 1 if bst.update(fobj=lambda preds, ds: (g, h)) else 0
+
+
+def booster_train_num_data(bst: Booster) -> int:
+    """Gradient buffer length for LGBM_BoosterUpdateOneIterCustom:
+    num_data * num_class (c_api.h:589-595 contract)."""
+    return int(bst._model.num_data * bst._model.num_class)

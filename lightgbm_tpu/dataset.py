@@ -645,7 +645,11 @@ class Dataset:
                 [len(g) for g in self.efb.groups], np.int32)
             payload["efb_group_members"] = np.asarray(
                 [j for g in self.efb.groups for j in g], np.int32)
-        np.savez_compressed(path, **payload)
+        # write through a file object so the EXACT requested filename is
+        # honored (np.savez appends '.npz' to bare string paths — the
+        # reference C API contract saves to the caller's name verbatim)
+        with open(path, "wb") as f:
+            np.savez_compressed(f, **payload)
 
     @classmethod
     def load_binary(cls, path: str) -> "Dataset":

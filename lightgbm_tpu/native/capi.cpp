@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <cstring>
 #include <fstream>
+#include <limits>
 #include <sstream>
 #include <string>
 #include <vector>
@@ -421,11 +422,14 @@ int LGBM_BoosterPredictForCSR(BoosterHandle handle, const int32_t* indptr,
 #pragma omp for schedule(static)
 #endif
     for (int64_t i = 0; i < nrow; ++i) {
+      // guard malformed CSR entries (index out of [0, ncol)) instead of
+      // writing out of bounds — the reference predictor drops feature
+      // indices past the model's range the same way
       for (int64_t e = indptr[i]; e < indptr[i + 1]; ++e)
-        row[indices[e]] = data[e];
+        if (indices[e] >= 0 && indices[e] < ncol) row[indices[e]] = data[e];
       b->PredictRow(row.data(), t0, t1, predict_type, out_result + i * width);
       for (int64_t e = indptr[i]; e < indptr[i + 1]; ++e)
-        row[indices[e]] = 0.0;
+        if (indices[e] >= 0 && indices[e] < ncol) row[indices[e]] = 0.0;
     }
   }
   if (out_len) *out_len = nrow * width;
@@ -455,6 +459,25 @@ int LGBM_BoosterPredictForCSRSingleRow(BoosterHandle handle,
 // one-based only assumable — a zero-based file whose feature 0 is absent
 // everywhere is indistinguishable from a one-based file missing its last
 // feature (the same ambiguity sklearn's zero_based="auto" accepts).
+// Per-token numeric conversion for dense rows: empty or unparsable text
+// ("NA", "nan", "?", ...) maps to missing (NaN) instead of aborting the
+// whole file — the reference parser's Atof treats unparsable fields as
+// NaN the same way.
+static double TokToDouble(const std::string& tok) {
+  if (tok.empty()) return std::numeric_limits<double>::quiet_NaN();
+  try {
+    size_t used = 0;
+    double v = std::stod(tok, &used);
+    // trailing garbage ("12abc") is unparsable, not a number
+    while (used < tok.size() &&
+           (tok[used] == ' ' || tok[used] == '\r')) ++used;
+    return used == tok.size() ? v
+                              : std::numeric_limits<double>::quiet_NaN();
+  } catch (const std::exception&) {
+    return std::numeric_limits<double>::quiet_NaN();
+  }
+}
+
 static int DetectLibsvmBase(std::ifstream* in) {
   std::string line;
   int base = 1;
@@ -520,7 +543,11 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
       if (first && data_has_header) { first = false; continue; }
       first = false;
       if (line.empty()) continue;
-      std::fill(row.begin(), row.end(), 0.0);
+      // dense rows: absent trailing fields are MISSING (NaN), matching
+      // the reference parser; libsvm rows: absent features are sparse
+      // zeros
+      std::fill(row.begin(), row.end(),
+                libsvm ? 0.0 : std::numeric_limits<double>::quiet_NaN());
       std::istringstream is(line);
       std::string tok;
       char sep = line.find('\t') != std::string::npos ? '\t' : ',';
@@ -538,8 +565,7 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
         // when label_column is default), remaining are features
         int col = -1;
         while (std::getline(is, tok, sep)) {
-          if (col >= 0 && col < ncol)
-            row[col] = tok.empty() ? std::nan("") : std::stod(tok);
+          if (col >= 0 && col < ncol) row[col] = TokToDouble(tok);
           ++col;
         }
       }

@@ -161,3 +161,61 @@ def test_predict_file_csv_and_libsvm(tmp_path):
     out_svm = tmp_path / "pred_svm.txt"
     nb.predict_file(str(svm), str(out_svm))
     np.testing.assert_allclose(np.loadtxt(out_svm), ref, rtol=1e-9)
+
+
+def test_predict_file_na_tokens_and_short_rows(tmp_path):
+    # ADVICE r3: "NA"/text fields map to missing (NaN) instead of aborting
+    # the file, and rows shorter than ncol leave trailing features missing
+    # rather than 0.0 (reference parser missing-value semantics)
+    rng = np.random.RandomState(7)
+    x = rng.randn(300, 4)
+    y = (x[:, 0] - x[:, 3] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 15,
+                  "use_missing": True}, x, y)
+    nb = _roundtrip(bst, tmp_path)
+
+    xt = rng.randn(3, 4)
+    csv = tmp_path / "na.csv"
+    lines = []
+    for i, r in enumerate(xt):
+        cells = ["0"] + [f"{v:.8f}" for v in r]
+        if i == 0:
+            cells[2] = "NA"            # text token -> NaN
+        if i == 1:
+            cells = cells[:3]          # short row -> trailing NaN
+        lines.append(",".join(cells))
+    csv.write_text("\n".join(lines) + "\n")
+    out = tmp_path / "na_out.txt"
+    nb.predict_file(str(csv), str(out))
+    got = np.loadtxt(str(out))
+
+    xt_expect = xt.copy()
+    xt_expect[0, 1] = np.nan
+    xt_expect[1, 2:] = np.nan
+    want = bst.predict(xt_expect)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-7)
+
+
+def test_csr_out_of_range_indices_ignored(tmp_path):
+    # malformed CSR entries (index < 0 or >= ncol) are dropped, not an
+    # out-of-bounds heap write
+    import ctypes
+    rng = np.random.RandomState(8)
+    x = rng.randn(200, 5)
+    y = (x[:, 0] > 0).astype(np.float64)
+    bst = _train({"objective": "binary", "num_leaves": 7}, x, y)
+    nb = _roundtrip(bst, tmp_path)
+
+    row = np.array([0.5, -1.2], dtype=np.float64)
+    indptr = np.array([0, 2], dtype=np.int32)
+    bad_indices = np.array([0, 99], dtype=np.int32)   # 99 >= ncol=5
+    out = np.zeros((1, 1), dtype=np.float64)
+    out_len = ctypes.c_int64(0)
+    rc = nb._lib.LGBM_BoosterPredictForCSR(
+        nb._handle, indptr, 2, bad_indices, row, 2, 5, 0, 0, -1,
+        ctypes.byref(out_len), out)
+    assert rc == 0
+    out = out[:, 0]
+    dense = np.zeros((1, 5))
+    dense[0, 0] = 0.5                  # the bad entry contributes nothing
+    np.testing.assert_allclose(out, bst.predict(dense), rtol=2e-5, atol=1e-7)

