@@ -147,6 +147,16 @@ _PARAMS: Dict[str, tuple] = {
     "label_gain": (list, None, []),
     "objective_seed": (int, 5, []),
     # ---- metric ----
+    # CLI conf-file pointer (config.h:99 ``config``): consumed by the
+    # CLI layer (cli.py loads the file and merges); inert as a library
+    # param, mirroring the reference where only main.cpp reads it
+    "config": (str, "", ["config_file"]),
+    # external parser spec (config.h parser_config_file): the reference
+    # feeds it to its pluggable Parser factory; this framework covers the
+    # same extension point with the Python-side registry
+    # (data_io.py register_parser), so the path is accepted for CLI/conf
+    # compatibility and custom formats are registered in Python instead
+    "parser_config_file": (str, "", []),
     "metric": (list, None, ["metrics", "metric_types"]),
     "metric_freq": (int, 1, ["output_freq"]),
     "is_provide_training_metric": (bool, False, ["training_metric", "is_training_metric",
@@ -304,6 +314,10 @@ def _auto_num(tok: str) -> Union[int, float, str]:
         return tok
 
 
+# unknown parameter names already warned about (once per process)
+_warned_unknown: set = set()
+
+
 class Config:
     """Dataclass-of-record holding every hyperparameter.
 
@@ -329,7 +343,16 @@ class Config:
         for key, value in params.items():
             name = _ALIASES.get(key, key)
             if name not in _PARAMS:
-                # Unknown keys are kept (callbacks / custom use) but not typed.
+                # Unknown keys are kept (callbacks / custom use) but not
+                # typed — and warned ONCE per key per process, like the
+                # reference's "Unknown parameter" message (config.cpp Set
+                # tail); one train() call constructs several Configs
+                # (engine/booster/dataset), so an unconditional warning
+                # would repeat 2-4x per call
+                if key not in _warned_unknown:
+                    _warned_unknown.add(key)
+                    from .utils.log import Log
+                    Log.warning(f"Unknown parameter: {key}")
                 setattr(self, name, value)
                 continue
             if name in seen:

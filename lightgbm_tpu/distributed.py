@@ -1,0 +1,265 @@
+"""Cluster orchestration: the reference's Dask-layer analog, TPU-shaped.
+
+The reference orchestrates multi-machine training from Python with
+dask.py (/root/reference/python-package/lightgbm/dask.py:393-810
+``_train``: find each worker's data parts, allocate one port per worker
+machine, build the ``machines=ip1:port1,ip2:port2`` parameter, then run
+one trainer per worker wired through ``LGBM_NetworkInit``).  A TPU
+cluster's unit of scheduling is a process per host over a device mesh,
+so the analog here has two halves:
+
+- :func:`run` — the *launcher* (dask._train's port-allocation and
+  process bring-up role, shaped like torchrun): spawns N coordinated
+  worker processes on this machine (or emits the per-host command lines
+  for a real multi-host cluster), each bootstrapped through
+  ``parallel.launch.init`` with the machines-parameter conventions.
+- :func:`train` — the *per-worker trainer* (dask._train_part's role):
+  an SPMD entry every process calls identically; it shards rows, fits
+  globally-consistent bin mappers (sharded FindBin + allgather,
+  parallel/dist_data.py), constructs the local Dataset and trains with
+  ``tree_learner=data`` over the global mesh.  On a TPU pod slice, call
+  :func:`train` directly from your per-host script — the JAX runtime is
+  the launcher there.
+
+Worker functions are addressed as ``"module:function"`` (the launcher
+re-imports them in each spawned process), receive a
+:class:`WorkerContext` and may return any picklable result;
+:func:`run` returns the per-rank results rank-ordered.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, List, NamedTuple, Optional
+
+import numpy as np
+
+
+class WorkerContext(NamedTuple):
+    """What every spawned worker receives (dask.py passes the same facts
+    through its closure: rank via worker address, machines string,
+    listen port)."""
+    rank: int
+    num_workers: int
+    machines: str            # "host1:port1,host2:port2" (config.h machines)
+    local_listen_port: int
+
+
+def _free_ports(n: int) -> List[int]:
+    """Allocate n distinct free localhost ports (dask.py:_find_n_open_ports
+    role)."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def build_machines(hosts: List[str], ports: List[int]) -> str:
+    """The reference ``machines`` parameter (config.h; dask.py:700)."""
+    return ",".join(f"{h}:{p}" for h, p in zip(hosts, ports))
+
+
+def run(entry: str, num_workers: int = 2, *,
+        hosts: Optional[List[str]] = None,
+        base_port: Optional[int] = None,
+        backend: str = "cpu",
+        args: Any = None,
+        timeout: int = 600,
+        extra_pythonpath: Optional[List[str]] = None) -> List[Any]:
+    """Spawn ``num_workers`` coordinated training processes on this
+    machine and return their results rank-ordered.
+
+    entry: ``"module:function"`` — imported in each worker; called as
+      ``function(ctx)`` or ``function(ctx, args)`` when ``args`` given.
+    hosts: one entry per worker for a REAL cluster (the function then
+      only prints the per-host command lines — a cluster scheduler, not
+      this process, must start them); default localhost spawning.
+    backend: "cpu" pins workers to the CPU backend with gloo collectives
+      (the test topology; also what the reference's distributed tests
+      do over localhost sockets); "" leaves device selection to JAX
+      (TPU pod workers).
+    """
+    if hosts is not None and set(hosts) - {"127.0.0.1", "localhost"}:
+        ports = [base_port or 12400] * len(hosts)
+        machines = build_machines(hosts, ports)
+        lines = [
+            f"{sys.executable} -m lightgbm_tpu.distributed "
+            f"--entry {entry} --rank {i} --num-workers {len(hosts)} "
+            f"--machines {machines}" for i in range(len(hosts))]
+        raise SystemExit(
+            "multi-host cluster: start one process per host:\n  "
+            + "\n  ".join(lines))
+
+    ports = _free_ports(num_workers)
+    machines = build_machines(["127.0.0.1"] * num_workers, ports)
+    tmp = tempfile.mkdtemp(prefix="lgbm_tpu_dist_")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)           # worker sets its own device count
+    if extra_pythonpath:
+        env["PYTHONPATH"] = os.pathsep.join(
+            list(extra_pythonpath) + [env.get("PYTHONPATH", "")])
+    args_path = ""
+    if args is not None:
+        args_path = os.path.join(tmp, "args.pkl")
+        with open(args_path, "wb") as f:
+            pickle.dump(args, f)
+
+    # worker output goes to FILES, not pipes: the workers run coordinated
+    # collectives, so blocking on one worker's full pipe buffer would
+    # stall its collectives and deadlock the whole cluster
+    procs, logs = [], []
+    for rank in range(num_workers):
+        cmd = [sys.executable, "-m", "lightgbm_tpu.distributed",
+               "--entry", entry, "--rank", str(rank),
+               "--num-workers", str(num_workers),
+               "--machines", machines,
+               "--result", os.path.join(tmp, f"r{rank}.pkl"),
+               "--backend", backend]
+        if args_path:
+            cmd += ["--args", args_path]
+        log = open(os.path.join(tmp, f"r{rank}.log"), "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=log,
+                                      stderr=subprocess.STDOUT, text=True))
+    deadline = time.monotonic() + timeout
+    try:
+        for p in procs:
+            p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    outs = []
+    for log in logs:
+        log.flush()
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"worker {rank} failed (rc={p.returncode}):\n{out[-3000:]}")
+    results = []
+    for rank in range(num_workers):
+        with open(os.path.join(tmp, f"r{rank}.pkl"), "rb") as f:
+            results.append(pickle.load(f))
+    return results
+
+
+def train(params: dict, x: np.ndarray, y: Optional[np.ndarray] = None, *,
+          weight: Optional[np.ndarray] = None,
+          num_boost_round: int = 100,
+          shard_rows: bool = True,
+          sample_count: int = 200_000,
+          valid: Optional[tuple] = None):
+    """SPMD per-worker trainer (dask.py:_train_part analog): every
+    process calls this identically; returns the (replicated) Booster.
+
+    params may carry the reference's network parameters — ``machines`` +
+    ``local_listen_port`` (config.h) — in which case the network is
+    initialized here exactly like ``LGBM_NetworkInit``; under :func:`run`
+    or on an already-initialized pod that step is a no-op.
+
+    shard_rows: x/y are the GLOBAL arrays and each process keeps its
+    contiguous shard (dataset_loader.cpp:203-298 per-rank partition);
+    pass False when each process loaded only its own rows already.
+    """
+    from . import Dataset, train as _engine_train
+    from .config import Config
+    from .parallel import launch
+
+    p = dict(params)
+    machines = str(p.pop("machines", "") or "")
+    port = int(p.pop("local_listen_port", 12400) or 12400)
+    if machines and not getattr(launch.init, "_done", False):
+        launch.init(machines=machines, local_listen_port=port)
+
+    import jax
+    pc = jax.process_count()
+    if pc > 1:
+        p.setdefault("num_machines", pc)
+        p.setdefault("tree_learner", "data")
+        if shard_rows:
+            sh = launch.row_shard(x, y)
+            if weight is not None:
+                # same deterministic contiguous partition as row_shard
+                parts = np.array_split(np.arange(len(x)), pc)
+                weight = np.asarray(weight)[parts[sh.process_index]]
+        else:
+            sh = launch.RowShard(x=x, y=y,
+                                 process_index=jax.process_index(),
+                                 process_count=pc)
+        cfg = Config(dict(p, num_iterations=num_boost_round))
+        cat_spec = str(getattr(cfg, "categorical_feature", "") or "")
+        cat = {int(t) for t in cat_spec.split(",") if t.strip().isdigit()} \
+            or None
+        mappers = launch.global_bin_mappers(sh.sample(sample_count), cfg,
+                                            cat_idx=cat)
+        ds = Dataset(sh.x, label=sh.y, weight=weight, params=p,
+                     bin_mappers=mappers)
+    else:
+        ds = Dataset(x, label=y, weight=weight, params=p)
+    kw = {}
+    if valid is not None:
+        vx, vy = valid
+        kw["valid_sets"] = [Dataset(vx, label=vy, params=p, reference=ds)]
+    return _engine_train(p, ds, num_boost_round=num_boost_round, **kw)
+
+
+def _main(argv: List[str]) -> None:
+    """Worker bootstrap (what ``run`` spawns): init the collective
+    runtime BEFORE any backend exists, then hand control to the entry."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="python -m lightgbm_tpu.distributed")
+    ap.add_argument("--entry", required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--num-workers", type=int, required=True)
+    ap.add_argument("--machines", required=True)
+    ap.add_argument("--result", default="")
+    ap.add_argument("--args", default="")
+    ap.add_argument("--backend", default="cpu")
+    ns = ap.parse_args(argv)
+
+    if ns.backend == "cpu":
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from .parallel import launch
+    entries = [m for m in ns.machines.split(",") if m]
+    launch.init(coordinator_address=entries[0],
+                num_processes=ns.num_workers, process_id=ns.rank)
+
+    mod_name, fn_name = ns.entry.split(":")
+    import importlib
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    ctx = WorkerContext(rank=ns.rank, num_workers=ns.num_workers,
+                        machines=ns.machines,
+                        local_listen_port=int(
+                            entries[ns.rank].rsplit(":", 1)[1]))
+    if ns.args:
+        with open(ns.args, "rb") as f:
+            result = fn(ctx, pickle.load(f))
+    else:
+        result = fn(ctx)
+    if ns.result:
+        with open(ns.result, "wb") as f:
+            pickle.dump(result, f)
+
+
+if __name__ == "__main__":
+    _main(sys.argv[1:])

@@ -66,7 +66,9 @@ class _GrowState(NamedTuple):
     # per-leaf allowed output range (monotone 'basic' method; ±inf w/o)
     olo: jax.Array               # [L] f32
     ohi: jax.Array               # [L] f32
-    # per-leaf allowed features (interaction constraints; [1,1] w/o)
+    # per-leaf BRANCH feature sets (interaction constraints; [1,1] w/o) —
+    # the allowed mask is derived per step by subset containment against
+    # the constraint groups (col_sampler.hpp:91-111 GetByNode)
     fallow: jax.Array            # [L, F] bool (or [L, 1] placeholder)
     # features already split on (CEGB coupled penalties; [1] w/o)
     cuse: jax.Array              # [F] bool (or [1] placeholder)
@@ -116,7 +118,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 extra_trees: bool = False, extra_seed: int = 6,
                 split_batch: int = 1,
                 mono=None, mono_penalty: float = 0.0,
-                interaction_allow=None,
+                interaction_groups=None,
                 bynode_frac: float = 1.0, bynode_seed: int = 0,
                 cegb=None,
                 jit: bool = True):
@@ -155,10 +157,13 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       totals reconstructing the shared default bin (FixHistogram,
       dataset.cpp:1292).  Row partitioning decodes the winning feature's
       bins from its group column.
-    - interaction_allow: [F, F] bool allowed-interaction matrix
-      (ColSampler / col_sampler.hpp interaction constraints): per-leaf
-      allowed-feature masks are tracked on device ([L, F] state); a split
-      on feature f restricts both children to ``parent_mask & allow[f]``.
+    - interaction_groups: [G, F] bool constraint-group matrix (ColSampler
+      / col_sampler.hpp:91-111 GetByNode): per-leaf BRANCH feature sets
+      are tracked on device ([L, F] state); a leaf may split on its
+      branch features plus the union of the groups that contain the
+      whole branch set (subset containment — progressive intersection
+      diverges for overlapping groups), and the root is restricted to
+      the union of all groups.
     - bynode_frac/bynode_seed: feature_fraction_bynode — every candidate
       leaf evaluation draws its own random feature subset in-graph
       (keyed by iteration/step/child so the fused scan reproduces the
@@ -259,9 +264,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                                          jnp.float32)
     mono_dev = None if mono is None else jnp.asarray(mono, jnp.int32)
     use_mono = mono_dev is not None
-    inter_dev = None if interaction_allow is None \
-        else jnp.asarray(interaction_allow, bool)
+    inter_dev = None if interaction_groups is None \
+        else jnp.asarray(interaction_groups, bool)     # [G, F]
     use_inter = inter_dev is not None
+
+    def _inter_allowed(branch):
+        """GetByNode: branch ∪ (∪ groups that contain the whole branch).
+        ``branch`` [F] bool -> allowed [F] bool.  An empty branch is a
+        subset of every group -> union of all groups (root case)."""
+        contains = (inter_dev | ~branch[None, :]).all(axis=1)      # [G]
+        return (inter_dev & contains[:, None]).any(axis=0) | branch
     use_bynode = 0.0 < float(bynode_frac) < 1.0
     use_cegb = cegb is not None and cegb.active
     if use_cegb:
@@ -400,12 +412,17 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                              (feature_mask.shape[0],), num_bin)
         bn_key = None
         fmask_root = feature_mask
+        if use_inter:
+            # root branch is empty -> only the union of all groups is
+            # splittable (col_sampler.hpp:99-100)
+            fmask_root = fmask_root & _inter_allowed(
+                jnp.zeros(feature_mask.shape[0], bool))
         if use_bynode:
             bn_key = jax.random.PRNGKey(bynode_seed)
             if rng_iter is not None:
                 bn_key = jax.random.fold_in(bn_key, rng_iter)
             fmask_root = _bynode_mask(jax.random.fold_in(bn_key, 0),
-                                      feature_mask)
+                                      fmask_root)
         kw = {"gain_scale": gscale, "rand_bin": rb0}
         if use_mono:
             kw.update(mono=mono_dev, out_lo=jnp.float32(-jnp.inf),
@@ -430,7 +447,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                            jnp.float32).at[0].set(hist0),
             olo=jnp.full(nleaf, neg_inf),
             ohi=jnp.full(nleaf, jnp.inf),
-            fallow=jnp.ones((nleaf, nf if use_inter else 1), bool),
+            # branch sets start empty (root has no ancestors)
+            fallow=jnp.zeros((nleaf, nf if use_inter else 1), bool),
             cuse=cuse0 if cuse0 is not None else jnp.zeros(1, bool),
             bg=jnp.full(nleaf, neg_inf).at[0].set(res0.gain),
             bf=jnp.zeros(nleaf, jnp.int32).at[0].set(res0.feature),
@@ -484,7 +502,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                          feature_mask.shape[0], hist0, total0,
                          root_out, res0, cuse0)
 
-        def split_step(i, st: _GrowState) -> _GrowState:
+        def split_step(st: _GrowState) -> _GrowState:
+            # one split per step, so the node id IS the split count so far
+            i = st.num_leaves - 1
             leaf = jnp.argmax(st.bg).astype(jnp.int32)
             can_split = (st.bg[leaf] > 0.0) & (~st.done)
 
@@ -570,10 +590,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 if per_leaf_mask:
                     nf = feature_mask.shape[0]
                     if use_inter:
-                        child_allow = st.fallow[leaf] & inter_dev[feat]
-                        fallow = st.fallow.at[leaf].set(child_allow) \
-                                          .at[new_leaf].set(child_allow)
-                        base = child_allow & feature_mask
+                        child_branch = st.fallow[leaf] | (
+                            jnp.arange(nf, dtype=jnp.int32) == feat)
+                        fallow = st.fallow.at[leaf].set(child_branch) \
+                                          .at[new_leaf].set(child_branch)
+                        base = _inter_allowed(child_branch) & feature_mask
                     else:
                         base = feature_mask
                     if use_bynode:
@@ -639,7 +660,13 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             return lax.cond(can_split, do_split,
                             lambda s: s._replace(done=jnp.bool_(True)), st)
 
-        st = lax.fori_loop(0, L - 1, split_step, st)
+        # while_loop, not a fixed L-1 fori_loop: a tree that stops early
+        # (no positive gain) exits instead of running no-op tail steps —
+        # with 255-leaf budgets those dead steps used to dominate small
+        # trees' device time (each one still copies the multi-MB carried
+        # state through the cond).
+        st = lax.while_loop(
+            lambda s: (~s.done) & (s.num_leaves < L), split_step, st)
         return TreeArrays(
             num_leaves=st.num_leaves,
             split_feature=st.split_feature,
@@ -693,7 +720,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         kidx = jnp.arange(K, dtype=jnp.int32)
         nC = K if use_subtraction else 2 * K
 
-        def super_step(s, st: _GrowState) -> _GrowState:
+        def super_step(carry):
+            s, st = carry
             gains, leaves = lax.top_k(lax.slice_in_dim(st.bg, 0, L), K)
             num_nodes = st.num_leaves - 1
             budget = jnp.int32(L - 1) - num_nodes
@@ -803,11 +831,13 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 if per_leaf_mask:
                     nf = feature_mask.shape[0]
                     if use_inter:
-                        child_allow = st.fallow[leaf_sel] \
-                            & inter_dev[feat_k]              # [K, F]
-                        fallow = st.fallow.at[leaf_sel].set(child_allow) \
-                                          .at[new_leaf_sel].set(child_allow)
-                        base = child_allow & feature_mask[None]
+                        child_branch = st.fallow[leaf_sel] | (
+                            jnp.arange(nf, dtype=jnp.int32)[None]
+                            == feat_k[:, None])              # [K, F]
+                        fallow = st.fallow.at[leaf_sel].set(child_branch) \
+                                          .at[new_leaf_sel].set(child_branch)
+                        base = jax.vmap(_inter_allowed)(child_branch) \
+                            & feature_mask[None]
                     else:
                         base = jnp.broadcast_to(feature_mask[None],
                                                 (K, nf))
@@ -895,17 +925,21 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     cat_rank=st.cat_rank.at[node_sel].set(rank_k),
                 )
 
-            return lax.cond(can_split, do_split,
-                            lambda s: s._replace(done=jnp.bool_(True)), st)
+            return s + 1, lax.cond(can_split, do_split,
+                                   lambda s: s._replace(done=jnp.bool_(True)),
+                                   st)
 
-        # trip count must be L-1, not ceil((L-1)/K): a super-step splits
-        # only the leaves that HAVE positive gain (chain-shaped trees
-        # split one per step), so any static count below L-1 can stop a
-        # growable tree early.  Completed trees short-circuit: once the
-        # budget is exhausted ``can_split`` is False and every remaining
-        # step takes the no-op cond branch (a [L] top_k and a flag set),
-        # so balanced trees still pay ~(L-1)/K histogram passes.
-        st = lax.fori_loop(0, L - 1, super_step, st)
+        # while_loop, not a fixed trip count: a super-step splits only the
+        # leaves that HAVE positive gain (chain-shaped trees take 1 split
+        # per step, balanced trees ~K), so no static count below L-1 is
+        # safe — and a fixed L-1 count makes balanced 255-leaf trees pay
+        # ~(L-1)(1-1/K) dead steps, each copying the multi-MB carried
+        # state through the cond's no-op branch.  The loop exits the
+        # moment the budget is exhausted or no leaf can split; the step
+        # counter ``s`` is carried for the bynode RNG stream.
+        _, st = lax.while_loop(
+            lambda c: (~c[1].done) & (c[1].num_leaves < L), super_step,
+            (jnp.int32(0), st))
         return TreeArrays(
             num_leaves=st.num_leaves,
             split_feature=st.split_feature[:L - 1],

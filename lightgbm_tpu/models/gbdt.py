@@ -75,6 +75,8 @@ class GBDTModel:
         self.num_features = ds.num_features
         if self.num_features == 0:
             raise ValueError("Dataset has no usable (non-trivial) features")
+        import jax as _jax
+        self._pc = _jax.process_count()   # >1 = one controller per host
 
         # learner selection (the device_type axis, tree_learner.cpp:16-64):
         # - partitioned: host-orchestrated, histogram work ∝ smaller child —
@@ -236,7 +238,19 @@ class GBDTModel:
         if dist in ("data", "voting"):
             from ..parallel.data_parallel import shard_rows
             n_sh = self._mesh.shape[self._dist_axis]
-            self._row_pad = (-self.num_data) % n_sh
+            if self._pc > 1:
+                # multi-process (one controller per host): each process
+                # holds only ITS rows; all processes must contribute the
+                # same local row count to the global array, so pad to the
+                # allgathered max rounded up to the local device count
+                from jax.experimental import multihost_utils
+                counts = np.asarray(multihost_utils.process_allgather(
+                    np.asarray(self.num_data)))
+                ldev = max(n_sh // self._pc, 1)
+                target = -(-int(counts.max()) // ldev) * ldev
+                self._row_pad = target - self.num_data
+            else:
+                self._row_pad = (-self.num_data) % n_sh
             if self._row_pad:
                 feat_binned = np.concatenate(
                     [feat_binned, np.zeros((self._row_pad,
@@ -264,15 +278,25 @@ class GBDTModel:
             self.binned_dev = jnp.asarray(feat_binned)
 
         # split_batch resolution (config.py): 0 = auto -> strict leaf-wise
-        # below 64 leaves, 8-way super-steps above (PROFILE.md: the
+        # below 64 leaves, K-way super-steps above (PROFILE.md: the
         # histogram contraction is sublane-bound at M=3; batching K leaves
-        # is the only way to raise that ceiling).  Voting stays strict:
-        # its per-split top-k feature votes are per-histogram-pass.
+        # is the only way to raise that ceiling — M=3K of the MXU's 128
+        # rows, so K=16 at 255 leaves lifts utilization to ~37% where K=8
+        # sat at ~18%).  Voting stays strict: its per-split top-k feature
+        # votes are per-histogram-pass.
         sb = config.split_batch
         self._split_batch = sb if sb >= 1 else \
-            (8 if config.num_leaves >= 64 else 1)
+            (16 if config.num_leaves >= 128 else
+             8 if config.num_leaves >= 64 else 1)
         if dist == "voting":
             self._split_batch = 1
+        if sb < 1 and self._split_batch > 1:
+            from ..utils.log import Log
+            Log.info(
+                f"num_leaves={config.num_leaves} auto-selects "
+                f"split_batch={self._split_batch} (top-K batched growth; "
+                "trees differ slightly from strict leaf-wise order — set "
+                "split_batch=1 for exact reference growth)")
 
         if dist == "data":
             from ..parallel.data_parallel import make_dp_grower
@@ -309,7 +333,7 @@ class GBDTModel:
                 block_rows=config.rows_per_block, mono=mono,
                 mono_method=config.monotone_constraints_method,
                 mono_penalty=config.monotone_penalty,
-                interaction_allow=inter,
+                interaction_groups=inter,
                 bynode_frac=config.feature_fraction_bynode,
                 bynode_seed=config.feature_fraction_seed + 1,
                 efb=self.efb_dev,
@@ -335,7 +359,7 @@ class GBDTModel:
                 split_batch=self._split_batch,
                 mono=self._mono if mono_masked_ok else None,
                 mono_penalty=config.monotone_penalty,
-                interaction_allow=inter,
+                interaction_groups=inter,
                 bynode_frac=config.feature_fraction_bynode,
                 bynode_seed=config.feature_fraction_seed + 1,
                 cegb=self._cegb_state)
@@ -360,9 +384,7 @@ class GBDTModel:
         self.models: List[Tree] = []          # host trees, grouped per iter
         self.device_trees: List[_DeviceTree] = []
         self.tree_weights: List[float] = []   # DART/RF reweighting
-        self._rng_bag = np.random.RandomState(config.bagging_seed)
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
-        self._bag_mask: Optional[np.ndarray] = None
         self._goss = config.data_sample_strategy == "goss"
         self._last_iter_state: Optional[dict] = None
 
@@ -551,9 +573,46 @@ class GBDTModel:
         if self._row_pad:
             vals = jnp.concatenate(
                 [vals, jnp.zeros((self._row_pad, vals.shape[1]), vals.dtype)])
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        return jax.device_put(
-            vals, NamedSharding(self._mesh, P(self._dist_axis, None)))
+        from ..parallel.data_parallel import shard_rows
+        return shard_rows(self._mesh, vals, self._dist_axis)
+
+    def _boost_from_score(self, class_id: int) -> float:
+        """BoostFromScore with reference multi-machine semantics: the
+        initial score comes from the GLOBAL label/weight statistics
+        (binary_objective.hpp BoostFromScore runs after a network
+        allreduce of suml/sumw), not this process's shard."""
+        if self._pc <= 1 or self._dist is None:
+            return self.objective.boost_from_score(class_id)
+        from jax.experimental import multihost_utils
+        obj = self.objective
+        lab = np.asarray(self.train_set.metadata.label, np.float64)
+        w = self.train_set.metadata.weight
+        w = np.ones_like(lab) if w is None else np.asarray(w, np.float64)
+        pad = self.num_data + self._row_pad - len(lab)
+        stacked = np.stack([np.pad(lab, (0, pad)), np.pad(w, (0, pad))])
+        g = np.asarray(multihost_utils.process_allgather(stacked))
+        glab = g[:, 0].reshape(-1)
+        gw = g[:, 1].reshape(-1)
+        keep = gw > 0.0            # padded rows carry zero weight
+        # a fresh instance init'd on the GLOBAL metadata: objectives
+        # derive their boost statistics (label counts, means) in init()
+        from ..dataset import Metadata
+        md = Metadata(int(keep.sum()))
+        md.label = glab[keep].astype(np.float32)
+        if self.train_set.metadata.weight is not None:
+            md.weight = gw[keep].astype(np.float32)
+        gobj = type(obj)(self.config)
+        gobj.init(md, md.num_data)
+        return gobj.boost_from_score(class_id)
+
+    def _localize_rows(self, global_arr: jax.Array) -> jax.Array:
+        """This process's rows of a row-sharded global array, pad dropped
+        (multi-process only; shards ordered by global row offset)."""
+        shards = sorted(global_arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        parts = [np.asarray(s.data) for s in shards]
+        local = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        return jnp.asarray(local[:self.num_data])
 
     def _prep_fmask(self, fmask: jax.Array) -> jax.Array:
         if self._feat_pad:
@@ -563,8 +622,13 @@ class GBDTModel:
     @staticmethod
     def _interaction_allow(config: Config, ds: Dataset):
         """Parse interaction_constraints ("[0,1],[2,3]" over original feature
-        indices) into an allowed-interaction matrix over used-feature slots
-        (ColSampler analog, col_sampler.hpp)."""
+        indices) into a [G, F] constraint-GROUP matrix over used-feature
+        slots (ColSampler, col_sampler.hpp:91-111 GetByNode): a leaf's
+        allowed features are its branch set plus the union of the groups
+        that contain the WHOLE branch set — overlapping groups compose by
+        subset containment, not by progressive intersection, and features
+        in no group are unusable (an empty branch allows only the union
+        of all groups)."""
         spec = config.interaction_constraints
         if not spec:
             return None
@@ -576,18 +640,12 @@ class GBDTModel:
             return None
         slot_of_orig = {f: i for i, f in enumerate(ds.used_features)}
         nf = len(ds.used_features)
-        allow = np.zeros((nf, nf), bool)
-        for slot, orig in enumerate(ds.used_features):
-            in_any = False
-            for grp in groups:
-                if orig in grp:
-                    in_any = True
-                    for member in grp:
-                        if member in slot_of_orig:
-                            allow[slot, slot_of_orig[member]] = True
-            if not in_any:
-                allow[slot, slot] = True
-        return allow
+        gm = np.zeros((len(groups), nf), bool)
+        for gi, grp in enumerate(groups):
+            for member in grp:
+                if member in slot_of_orig:
+                    gm[gi, slot_of_orig[member]] = True
+        return gm
 
     # -- plumbing ----------------------------------------------------------
     def add_valid_set(self, valid: Dataset) -> None:
@@ -619,24 +677,39 @@ class GBDTModel:
         self.valid_sets.append((valid, binned, score))
 
     # -- sampling (gbdt.cpp:230 Bagging + goss.hpp) ------------------------
-    def _bagging_mask(self) -> Optional[np.ndarray]:
+    @property
+    def _bagging_active(self) -> bool:
         cfg = self.config
-        freq, frac = cfg.bagging_freq, cfg.bagging_fraction
+        return cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+
+    def _bagging_w(self, it) -> jax.Array:
+        """In-graph bagging mask (gbdt.cpp:230-264 Bagging): the draw is
+        keyed by the iteration's refresh epoch ``(it // freq) * freq`` so
+        the mask is identical for ``bagging_freq`` consecutive iterations
+        and identical between the per-iteration and fused-chunk paths —
+        ``it`` may be a traced scan index (the GOSS pattern).  Redrawing
+        per iteration instead of caching costs one [N] uniform + compare,
+        noise next to a histogram pass."""
+        cfg = self.config
+        n = self.num_data
+        epoch = (it // cfg.bagging_freq) * cfg.bagging_freq
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed), epoch)
+        if self._pc > 1:
+            # per-host independent draws (the reference seeds its bagging
+            # RNG per rank the same way, gbdt.cpp bagging_rand_)
+            key = jax.random.fold_in(key, jax.process_index())
+        u = jax.random.uniform(key, (n,))
         pos_f, neg_f = cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
-        needs = freq > 0 and (frac < 1.0 or pos_f < 1.0 or neg_f < 1.0)
-        if not needs:
-            return None
-        if self.iter_ % freq == 0:
-            n = self.num_data
-            if (pos_f < 1.0 or neg_f < 1.0) and self.objective is not None \
-                    and self.objective.name == "binary":
-                lbl = np.asarray(self.train_set.metadata.label)
-                r = self._rng_bag.rand(n)
-                mask = np.where(lbl > 0, r < pos_f, r < neg_f)
-            else:
-                mask = self._rng_bag.rand(n) < frac
-            self._bag_mask = mask.astype(np.float32)
-        return self._bag_mask
+        if (pos_f < 1.0 or neg_f < 1.0) and self.objective is not None \
+                and self.objective.name == "binary":
+            lbl = jnp.asarray(
+                np.asarray(self.train_set.metadata.label) > 0)
+            mask = jnp.where(lbl, u < pos_f, u < neg_f)
+        else:
+            mask = u < cfg.bagging_fraction
+        return mask.astype(jnp.float32)
 
     def _goss_vals(self, g: jax.Array, h: jax.Array,
                    it: Optional[jax.Array] = None) -> jax.Array:
@@ -655,6 +728,8 @@ class GBDTModel:
         if it is None:
             it = self.iter_
         key = jax.random.PRNGKey(cfg.bagging_seed + it)
+        if self._pc > 1:
+            key = jax.random.fold_in(key, jax.process_index())
         u = jax.random.uniform(key, (n,))
         p_other = other_k / jnp.maximum(n - top_k, 1)
         is_other = (~is_top) & (u < p_other)
@@ -685,9 +760,6 @@ class GBDTModel:
         the f32 leaf-shrinkage in train_one_iter so toggling ``fused_chunk``
         never changes the trained model."""
         cfg = self.config
-        host_bagging = cfg.bagging_freq > 0 and (
-            cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
-            or cfg.neg_bagging_fraction < 1.0)
         return (type(self) is GBDTModel
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
@@ -697,7 +769,6 @@ class GBDTModel:
                 and self._learner_kind == "masked"
                 and self._dist is None
                 and not self._custom_hist_reduce
-                and not host_bagging
                 and self._forced_spec is None)
 
     def supports_fused(self) -> bool:
@@ -726,7 +797,7 @@ class GBDTModel:
                 split_batch=self._split_batch,
                 mono=self._mono if self._learner_kind == "masked" else None,
                 mono_penalty=cfg.monotone_penalty,
-                interaction_allow=self._inter,
+                interaction_groups=self._inter,
                 bynode_frac=cfg.feature_fraction_bynode,
                 bynode_seed=cfg.feature_fraction_seed + 1,
                 cegb=self._cegb_state,
@@ -734,6 +805,7 @@ class GBDTModel:
             obj = self.objective
             lr = jnp.float32(self.learning_rate)
             use_goss = self._goss
+            use_bag = self._bagging_active and not use_goss
             ic = self._ic_grow
 
             use_cegb = self._cegb_state is not None
@@ -743,8 +815,12 @@ class GBDTModel:
                 score, dead, cuse = carry
                 fmask, it = xs
                 g, h = obj.get_gradients(score[:, 0])
-                w = self._goss_vals(g, h, it) if use_goss \
-                    else jnp.ones_like(g)
+                if use_goss:
+                    w = self._goss_vals(g, h, it)
+                elif use_bag:
+                    w = self._bagging_w(it)
+                else:
+                    w = jnp.ones_like(g)
                 vals = jnp.stack([g * w, h * w, w], axis=1)
                 kw = {"is_cat": ic} if ic is not None else {}
                 if self._extra_trees or self._bynode_masked:
@@ -807,7 +883,7 @@ class GBDTModel:
         init0 = 0.0
         if start_iter == 0 and self.objective is not None \
                 and cfg.boost_from_average and not self._init_applied:
-            init0 = self.objective.boost_from_score(0)
+            init0 = self._boost_from_score(0)
             self._init_scores = [init0]
             if init0 != 0.0:
                 self.score = self.score + jnp.float32(init0)
@@ -871,7 +947,7 @@ class GBDTModel:
             # scorers before gradient computation; the saved tree gets the
             # bias via AddBias AFTER UpdateScore (gbdt.cpp:416-418)
             for k in range(self.num_class):
-                init_scores[k] = self.objective.boost_from_score(k)
+                init_scores[k] = self._boost_from_score(k)
             self._init_scores = list(init_scores)
             if any(s != 0.0 for s in init_scores) and not self._bias_in_every_tree:
                 bias = jnp.asarray(init_scores, jnp.float32)
@@ -895,7 +971,8 @@ class GBDTModel:
             g_all = g_all.reshape(self.num_data, self.num_class)
             h_all = h_all.reshape(self.num_data, self.num_class)
 
-        bag = self._bagging_mask()
+        bag = self._bagging_w(jnp.int32(self.iter_)) \
+            if self._bagging_active and not self._goss else None
         fmask = jnp.asarray(self._feature_mask())
 
         stopped = True
@@ -907,7 +984,7 @@ class GBDTModel:
             if self._goss:
                 w = self._goss_vals(g, h)
             elif bag is not None:
-                w = jnp.asarray(bag)
+                w = bag
             else:
                 w = jnp.ones(self.num_data, jnp.float32)
             vals = jnp.stack([g * w, h * w, w], axis=1)
@@ -941,7 +1018,19 @@ class GBDTModel:
             else:
                 arrays = self.grower(self.binned_dev, vals_g, fmask_g,
                                      self._nb_grow, self._na_grow, **gkw)
-            if self._row_pad:
+            if self._pc > 1 and self._dist is not None:
+                # multi-process: the grower returned GLOBAL arrays (tree
+                # fields replicated, leaf_of_row row-sharded).  Mixing
+                # them into this process's local score/valid math would
+                # make every later eager op a cross-process collective,
+                # so re-materialize everything process-locally: tree
+                # fields via one replicated fetch, this process's
+                # leaf_of_row rows from its own addressable shards.
+                small = arrays._replace(leaf_of_row=arrays.num_leaves)
+                host_g = jax.device_get(small)
+                arrays = jax.tree.map(jnp.asarray, host_g)._replace(
+                    leaf_of_row=self._localize_rows(arrays.leaf_of_row))
+            elif self._row_pad:
                 # drop padded rows before any host/score use of the
                 # row->leaf vector
                 arrays = arrays._replace(

@@ -82,31 +82,36 @@ def monotone_penalty_factor(penalty: float, depth):
                   1.0 - 2.0 ** (pen - 1.0 - d) + 1e-15))
 
 
-def leaf_output(sum_g, sum_h, p: SplitParams, parent_output=None):
-    """CalculateSplittedLeafOutput (feature_histogram.hpp:761-788)."""
+def leaf_output(sum_g, sum_h, p: SplitParams, parent_output=None,
+                count=None):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:742-764): raw
+    Newton step -> L1 threshold -> max_delta_step clamp -> path smoothing
+    (in the reference's order: the clamp applies to the RAW output, then
+    the smoothed blend may exceed it toward the parent).
+
+    Path smoothing blends with the leaf's DATA COUNT ``count``
+    (feature_histogram.hpp:760-761 ``num_data``), not its hessian weight —
+    they differ for every non-unit-hessian objective."""
     num = -threshold_l1(sum_g, p.lambda_l1)
     denom = sum_h + p.lambda_l2
-    if p.path_smooth > 0.0 and parent_output is not None:
-        # path smoothing: output = n/(n+λ_smooth) * raw + λ/(n+λ_smooth)*parent
-        raw = num / jnp.maximum(denom, kEpsilon)
-        # note: reference smooths with data count; approximated by hessian weight
-        n_data = sum_h
-        smooth_w = n_data / (n_data + p.path_smooth)
-        out = raw * smooth_w + parent_output * (1.0 - smooth_w)
-    else:
-        out = num / jnp.maximum(denom, kEpsilon)
+    out = num / jnp.maximum(denom, kEpsilon)
     if p.max_delta_step > 0.0:
         out = jnp.clip(out, -p.max_delta_step, p.max_delta_step)
+    if p.path_smooth > 0.0 and parent_output is not None:
+        # ret * (n/s)/(n/s + 1) + parent/(n/s + 1)
+        n_data = sum_h if count is None else count
+        smooth_w = n_data / (n_data + p.path_smooth)
+        out = out * smooth_w + parent_output * (1.0 - smooth_w)
     return out
 
 
-def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=None):
+def leaf_gain(sum_g, sum_h, p: SplitParams, parent_output=None, count=None):
     """GetLeafGain (feature_histogram.hpp:790-820): gain of a leaf with the
     (possibly clipped/smoothed) optimal output."""
     if p.max_delta_step <= 0.0 and p.path_smooth <= 0.0:
         t = threshold_l1(sum_g, p.lambda_l1)
         return t * t / jnp.maximum(sum_h + p.lambda_l2, kEpsilon)
-    out = leaf_output(sum_g, sum_h, p, parent_output)
+    out = leaf_output(sum_g, sum_h, p, parent_output, count)
     tg = threshold_l1(sum_g, p.lambda_l1)
     # GetLeafGainGivenOutput: -(2*G̃*w + (H+λ2)*w²)
     return -(2.0 * tg * out + (sum_h + p.lambda_l2) * out * out)
@@ -141,9 +146,11 @@ def _numerical_candidates(hist, total, num_bin, na_bin, feature_mask,
     gl, hl, cl = lefts[..., 0], lefts[..., 1], lefts[..., 2]
     gr, hr, cr = rights[..., 0], rights[..., 1], rights[..., 2]
 
-    gain_l = leaf_gain(gl, hl, params, parent_out)
-    gain_r = leaf_gain(gr, hr, params, parent_out)
-    gain_shift = leaf_gain(total[0], total[1], params)
+    gain_l = leaf_gain(gl, hl, params, parent_out, cl)
+    gain_r = leaf_gain(gr, hr, params, parent_out, cr)
+    # gain_shift smooths too (BeforeNumercal, feature_histogram.hpp:104-105
+    # passes num_data + parent_output into the leaf's own GetLeafGain)
+    gain_shift = leaf_gain(total[0], total[1], params, parent_out, total[2])
     split_gain = gain_l + gain_r - (gain_shift + params.min_gain_to_split)
 
     # validity masks (FindBestThresholdSequentially early-continue conditions)
@@ -202,9 +209,9 @@ def _categorical_candidates(hist, total, num_bin, cat_mask,
 
     gl, hl, cl = lefts[..., 0], lefts[..., 1], lefts[..., 2]
     gr, hr, cr = rights[..., 0], rights[..., 1], rights[..., 2]
-    gain_l = leaf_gain(gl, hl, pcat, parent_out)
-    gain_r = leaf_gain(gr, hr, pcat, parent_out)
-    gain_shift = leaf_gain(total[0], total[1], pcat)
+    gain_l = leaf_gain(gl, hl, pcat, parent_out, cl)
+    gain_r = leaf_gain(gr, hr, pcat, parent_out, cr)
+    gain_shift = leaf_gain(total[0], total[1], pcat, parent_out, total[2])
     split_gain = gain_l + gain_r - (gain_shift + params.min_gain_to_split)
 
     md = float(params.min_data_in_leaf) - 0.5
@@ -245,8 +252,10 @@ def _monotone_adjust(gains, lefts, total, mono, out_lo, out_hi, dir_axis,
     candidate threshold, so a split is only constrained by opposite
     leaves whose region actually overlaps that child's region."""
     rights = total[None, None, None, :] - lefts
-    out_l = leaf_output(lefts[..., 0], lefts[..., 1], params, parent_out)
-    out_r = leaf_output(rights[..., 0], rights[..., 1], params, parent_out)
+    out_l = leaf_output(lefts[..., 0], lefts[..., 1], params, parent_out,
+                        lefts[..., 2])
+    out_r = leaf_output(rights[..., 0], rights[..., 1], params, parent_out,
+                        rights[..., 2])
     if mono_bounds is not None:
         lo_l, hi_l, lo_r, hi_r = (b[None] for b in mono_bounds)  # [1,F,B]
         cl_l = jnp.clip(out_l, lo_l, hi_l)
@@ -376,10 +385,14 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
     right_sum = total - ls_
     # categorical splits regularize leaf outputs with l2 + cat_l2
     pcat = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
-    lo = jnp.where(ic_, leaf_output(ls_[0], ls_[1], pcat, parent_out),
-                   leaf_output(ls_[0], ls_[1], params, parent_out))
-    ro = jnp.where(ic_, leaf_output(right_sum[0], right_sum[1], pcat, parent_out),
-                   leaf_output(right_sum[0], right_sum[1], params, parent_out))
+    lo = jnp.where(ic_,
+                   leaf_output(ls_[0], ls_[1], pcat, parent_out, ls_[2]),
+                   leaf_output(ls_[0], ls_[1], params, parent_out, ls_[2]))
+    ro = jnp.where(ic_,
+                   leaf_output(right_sum[0], right_sum[1], pcat, parent_out,
+                               right_sum[2]),
+                   leaf_output(right_sum[0], right_sum[1], params, parent_out,
+                               right_sum[2]))
     if mono is not None:
         if mono_bounds is not None:
             lo_l, hi_l, lo_r, hi_r = mono_bounds

@@ -39,9 +39,20 @@ def pad_to_multiple(n: int, k: int) -> int:
 
 def shard_rows(mesh: Mesh, arr, axis: str = "data"):
     """Place a row-major array sharded over the mesh data axis (rows padded
-    by the caller to a multiple of the axis size)."""
+    by the caller to a multiple of the axis size).
+
+    Multi-process (one controller per host, the TPU-pod topology): ``arr``
+    is each process's LOCAL rows and the global array is assembled with
+    ``make_array_from_process_local_data`` — ``device_put`` of a global
+    value is single-controller-only (every process would need the whole
+    array, and JAX asserts the values match across processes).  The
+    caller must have padded every process to the same local row count."""
     spec = P(axis, *([None] * (np.ndim(arr) - 1)))
-    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding,
+                                                      np.asarray(arr))
+    return jax.device_put(jnp.asarray(arr), sharding)
 
 
 def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,

@@ -26,18 +26,35 @@ from ..grower import TreeArrays, make_grower
 from ..ops.split import SplitParams
 
 
-def _local_feature_gains(h: jax.Array) -> jax.Array:
-    """Cheap per-feature best-gain proxy from a local histogram [F, B, 3]:
-    max over thresholds of GL^2/HL + GR^2/HR (unregularized)."""
+def _local_feature_gains(h: jax.Array, params: SplitParams,
+                         n_shards: int) -> jax.Array:
+    """Per-feature best LOCAL split gain from a local histogram [F, B, 3]
+    — the vote statistic.  Matches the reference's local search setup:
+    L1/L2-regularized gains with the per-rank constraint rescale
+    ``min_data_in_leaf /= num_machines`` / ``min_sum_hessian_in_leaf /=
+    num_machines`` (voting_parallel_tree_learner.cpp:61-63 — a shard
+    only sees ~1/M of any leaf's rows, so unscaled constraints would
+    veto splits the GLOBAL histogram easily clears)."""
+    md = max(float(params.min_data_in_leaf) / n_shards, 1.0) - 0.5
+    mh = float(params.min_sum_hessian_in_leaf) / n_shards
+    l1, l2 = float(params.lambda_l1), float(params.lambda_l2)
     eps = 1e-10
     cum = jnp.cumsum(h, axis=1)
     total = cum[:, -1:, :]
-    gl, hl = cum[..., 0], cum[..., 1] + eps
+    gl, hl = cum[..., 0], cum[..., 1]
     gr = total[..., 0] - cum[..., 0]
-    hr = total[..., 1] - cum[..., 1] + eps
+    hr = total[..., 1] - cum[..., 1]
     cl, cr = cum[..., 2], total[..., 2] - cum[..., 2]
-    gains = gl * gl / hl + gr * gr / hr
-    gains = jnp.where((cl > 0.5) & (cr > 0.5), gains, -jnp.inf)
+
+    def tl1(g):
+        if l1 <= 0.0:
+            return g
+        return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+    gains = (tl1(gl) ** 2 / (hl + l2 + eps)
+             + tl1(gr) ** 2 / (hr + l2 + eps))
+    valid = (cl >= md) & (cr >= md) & (hl >= mh) & (hr >= mh)
+    gains = jnp.where(valid, gains, -jnp.inf)
     return jnp.max(gains, axis=1)                       # [F]
 
 
@@ -47,10 +64,12 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                        axis: str = "data"):
     """Jitted voting-parallel ``grow_tree`` over ``mesh`` (rows sharded)."""
 
+    n_shards = mesh.shape[axis]
+
     def vote_reduce(h):
         f = h.shape[0]
         k = min(top_k, f)
-        gains = _local_feature_gains(h)
+        gains = _local_feature_gains(h, params, n_shards)
         _, local_top = lax.top_k(gains, k)              # [k]
         onehot = jnp.zeros(f, jnp.float32).at[local_top].add(1.0)
         votes = lax.psum(onehot, axis)                  # [F] vote counts

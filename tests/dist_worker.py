@@ -1,0 +1,26 @@
+"""Worker entry for tests/test_distributed_module.py — imported by the
+``lightgbm_tpu.distributed`` launcher in each spawned process
+(``--entry dist_worker:worker``)."""
+
+import numpy as np
+
+
+def _global_data(n=4096, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float64)
+    y = (x[:, 0] - 0.7 * x[:, 1] + 0.2 * rng.randn(n) > 0) \
+        .astype(np.float32)
+    return x, y
+
+
+def worker(ctx, args):
+    from lightgbm_tpu import distributed
+    x, y = _global_data()
+    # global weights: distributed.train must shard them with the rows
+    w = np.full(len(y), 1.0, np.float32) if args.get("weighted") else None
+    bst = distributed.train(args["params"], x, y, weight=w,
+                            num_boost_round=args["rounds"])
+    # every rank must hold the same replicated model
+    return {"rank": ctx.rank, "machines": ctx.machines,
+            "model": bst.model_to_string(),
+            "pred_head": bst.predict(x[:64], raw_score=True).tolist()}

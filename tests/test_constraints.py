@@ -304,6 +304,59 @@ class TestInteractionMasked:
         bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=10)
         self._paths_ok(bst, [{0, 1}, {2, 3}])
 
+    def test_overlapping_groups(self):
+        # ADVICE r3 (medium): with overlapping groups [0,1],[1,2],[0,2] a
+        # progressive intersection allow[0]&allow[1] = {0,1,2} would let a
+        # path use all three features — a subset of NO group.  GetByNode
+        # subset-containment semantics (col_sampler.hpp:91-111) forbid it.
+        rs = np.random.RandomState(2)
+        n = 4000
+        x = rs.randn(n, 3)
+        y = (x[:, 0] * x[:, 1] + x[:, 1] * x[:, 2] + x[:, 0] * x[:, 2]
+             + 0.1 * rs.randn(n)).astype(np.float32)
+        groups = [{0, 1}, {1, 2}, {0, 2}]
+        for extra in ({}, {"tpu_learner": "masked"},
+                      {"tpu_learner": "masked", "split_batch": 4,
+                       "fused_chunk": 5}):
+            p = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+                 "min_data_in_leaf": 2, "verbose": -1,
+                 "interaction_constraints": "[0,1],[1,2],[0,2]", **extra}
+            bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=8)
+            deep = max(len(f) for t in bst.trees
+                       for f in self._iter_paths(t))
+            assert deep >= 2, "test setup: trees should mix two features"
+            self._paths_ok(bst, groups)
+
+    def test_unlisted_feature_never_used(self):
+        # a feature in no constraint group is unusable (root branch is
+        # empty -> allowed = union of all groups, col_sampler.hpp:99-100)
+        rs = np.random.RandomState(3)
+        n = 3000
+        x = rs.randn(n, 4)
+        y = (2.0 * x[:, 3] + 0.5 * x[:, 0] + 0.1 * rs.randn(n)) \
+            .astype(np.float32)   # the EXCLUDED feature is the strongest
+        for extra in ({}, {"tpu_learner": "masked"}):
+            p = {"objective": "regression", "num_leaves": 15, "max_bin": 63,
+                 "min_data_in_leaf": 5, "verbose": -1,
+                 "interaction_constraints": "[0,1],[1,2]", **extra}
+            bst = lgb.train(p, lgb.Dataset(x, label=y), num_boost_round=5)
+            used = {int(f) for t in bst.trees
+                    for f in t.split_feature[:t.num_nodes()]}
+            assert 3 not in used, f"unlisted feature used ({extra})"
+
+    @staticmethod
+    def _iter_paths(t):
+        if t.num_nodes() == 0:
+            return
+        def paths(node, feats):
+            if node < 0:
+                yield feats
+                return
+            nf = feats | {int(t.split_feature[node])}
+            yield from paths(t.left_child[node], nf)
+            yield from paths(t.right_child[node], nf)
+        yield from paths(0, set())
+
     def test_masked_bynode(self, binary_data):
         x, y = binary_data
         p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,

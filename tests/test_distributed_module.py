@@ -1,0 +1,60 @@
+"""User-facing cluster orchestration e2e (VERDICT r3 task 9): >= 2 REAL
+coordinated processes spawned THROUGH ``lightgbm_tpu.distributed.run``
+(the dask.py:393-810 _train analog: port allocation, machines parameter,
+one trainer per worker), each training via ``distributed.train`` with
+row sharding + distributed binning + data-parallel growth, then the
+replicated model must agree across ranks and match single-process
+training quality."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import distributed
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "min_data_in_leaf": 5, "verbosity": -1}
+ROUNDS = 8
+
+
+def test_run_spawns_coordinated_workers():
+    results = distributed.run(
+        "dist_worker:worker", num_workers=2,
+        args={"params": PARAMS, "rounds": ROUNDS, "weighted": True},
+        extra_pythonpath=[HERE], timeout=420)
+    assert [r["rank"] for r in results] == [0, 1]
+    # the machines parameter followed the reference conventions
+    assert results[0]["machines"].count(",") == 1
+    assert all(m.startswith("127.0.0.1:")
+               for m in results[0]["machines"].split(","))
+    # replicated model: byte-identical across ranks
+    assert results[0]["model"] == results[1]["model"]
+    np.testing.assert_allclose(results[0]["pred_head"],
+                               results[1]["pred_head"], rtol=1e-6)
+
+    # quality sanity vs a single-process run on the same global data
+    from dist_worker import _global_data
+    import sys
+    sys.path.insert(0, HERE)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.metrics import _auc
+    x, y = _global_data()
+    bst = lgb.train(dict(PARAMS), lgb.Dataset(x, label=y),
+                    num_boost_round=ROUNDS)
+    auc_single = _auc(y, bst.predict(x, raw_score=True), None)
+
+    from lightgbm_tpu.booster import Booster
+    dist_bst = Booster(model_str=results[0]["model"])
+    auc_dist = _auc(y, dist_bst.predict(x, raw_score=True), None)
+    assert auc_dist > 0.9
+    assert abs(auc_single - auc_dist) < 0.05
+
+
+def test_multi_host_emits_commands():
+    with pytest.raises(SystemExit) as ei:
+        distributed.run("dist_worker:worker", hosts=["10.0.0.1", "10.0.0.2"])
+    msg = str(ei.value)
+    assert "-m lightgbm_tpu.distributed" in msg
+    assert "--machines 10.0.0.1:12400,10.0.0.2:12400" in msg

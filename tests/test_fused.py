@@ -5,6 +5,7 @@ strings to the per-iteration path — same grower, same RNG streams (feature
 masks pre-drawn host-side, GOSS keys seeded by iteration index in-graph).
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -98,10 +99,44 @@ def test_fused_respects_remainder():
     assert len(b.trees) == 17
 
 
-def test_fused_not_used_with_bagging():
-    # host-RNG bagging disables fusion (supports_fused false) but training
-    # still works through the per-iter path
+def test_fused_bagging_parity():
+    # bagging masks are drawn IN-GRAPH keyed by the refresh epoch
+    # (gbdt.cpp:230-264 analog), so bagging configs fuse and the fused
+    # chunk reproduces the per-iteration models exactly
     x, y = _data()
-    p = dict(BASE, fused_chunk=10, bagging_freq=1, bagging_fraction=0.7)
-    b = _train(p, x, y, rounds=12)
-    assert len(b.trees) == 12
+    p = dict(BASE, bagging_freq=2, bagging_fraction=0.7)
+    b_fused = _train(dict(p, fused_chunk=6), x, y, rounds=12)
+    b_plain = _train(dict(p, fused_chunk=0), x, y, rounds=12)
+    assert b_fused._model.supports_fused()
+    assert len(b_fused.trees) == 12
+    assert _norm(b_fused.model_to_string()) == _norm(b_plain.model_to_string())
+    np.testing.assert_allclose(
+        np.asarray(b_fused._model.train_score()),
+        np.asarray(b_plain._model.train_score()), rtol=1e-6)
+
+
+def test_fused_pos_neg_bagging_parity():
+    # pos/neg bagging (binary objective) routes through the same in-graph
+    # draw with the device label vector
+    x, y = _data()
+    p = dict(BASE, bagging_freq=1, pos_bagging_fraction=0.8,
+             neg_bagging_fraction=0.5)
+    b_fused = _train(dict(p, fused_chunk=5), x, y, rounds=10)
+    b_plain = _train(dict(p, fused_chunk=0), x, y, rounds=10)
+    assert b_fused._model.supports_fused()
+    assert _norm(b_fused.model_to_string()) == _norm(b_plain.model_to_string())
+
+
+def test_bagging_mask_refresh_epochs():
+    # same mask within a bagging_freq window, different across windows
+    x, y = _data()
+    p = dict(BASE, bagging_freq=3, bagging_fraction=0.6)
+    b = _train(p, x, y, rounds=1)
+    m = b._model
+    w0 = np.asarray(m._bagging_w(jnp.int32(0)))
+    w2 = np.asarray(m._bagging_w(jnp.int32(2)))
+    w3 = np.asarray(m._bagging_w(jnp.int32(3)))
+    np.testing.assert_array_equal(w0, w2)
+    assert (w0 != w3).any()
+    frac = w0.mean()
+    assert 0.5 < frac < 0.7

@@ -129,7 +129,10 @@ def test_train_binary_reference_conf():
                     num_boost_round=20)
     from lightgbm_tpu.metrics import _auc
     auc = _auc(yt, np.asarray(bst.predict(xt, raw_score=True)), None)
-    assert auc > 0.78, f"valid AUC {auc} vs reference 0.8014"
+    # measured r4: 0.8234 — ABOVE the reference's 0.8014; the gate sits
+    # between them so it fails on a 0.01 drop while still requiring
+    # reference-level quality (VERDICT r3 task 10)
+    assert auc > 0.815, f"valid AUC {auc} (ours 0.8234, reference 0.8014)"
 
 
 def test_train_regression_reference_conf():
@@ -140,7 +143,8 @@ def test_train_regression_reference_conf():
     bst = lgb.train(params, lgb.Dataset(x, label=y, params=params),
                     num_boost_round=20)
     l2 = float(np.mean((np.asarray(bst.predict(xt)) - yt) ** 2))
-    assert l2 < 0.24, f"valid l2 {l2} vs reference 0.1989"
+    # measured r4: 0.1981, reference 0.1989 — gate at +2.5% of ours
+    assert l2 < 0.203, f"valid l2 {l2} (ours 0.1981, reference 0.1989)"
 
 
 def test_train_multiclass_reference_conf():
@@ -154,11 +158,17 @@ def test_train_multiclass_reference_conf():
                     num_boost_round=20)
     p = np.clip(np.asarray(bst.predict(xt)), 1e-15, 1.0)
     ll = float(np.mean(-np.log(p[np.arange(len(yt)), yt.astype(int)])))
-    assert ll < 1.65, f"valid multi_logloss {ll} vs reference 1.4663"
+    # measured r4: 1.5114 vs reference 1.4663 (+3.1%, the one example
+    # task we don't beat; binning/one-vs-rest ordering differences) —
+    # gate tracks OUR value with ~1.5% slack so regressions fail
+    assert ll < 1.535, f"valid multi_logloss {ll} (ours 1.5114, " \
+                       f"reference 1.4663)"
 
 
-@pytest.mark.parametrize("task,floor", [("lambdarank", 0.55),
-                                        ("xendcg", 0.55)])
+# measured r4: lambdarank 0.6589, xendcg 0.6579 — both above the
+# reference's ~0.63-0.65; floors fail on a 0.015 drop
+@pytest.mark.parametrize("task,floor", [("lambdarank", 0.645),
+                                        ("xendcg", 0.645)])
 def test_train_ranking_reference_conf(task, floor):
     ex_dir, prefix, _ = CASES[task]
     conf = load_conf(EXAMPLES / ex_dir / "train.conf")
@@ -174,4 +184,4 @@ def test_train_ranking_reference_conf(task, floor):
                     lgb.Dataset(x, label=y, group=qs, params=params),
                     num_boost_round=20)
     ndcg = _ndcg5(bst, xt, yt, qt)
-    assert ndcg > floor, f"{task} valid ndcg@5 {ndcg} vs reference ~0.63-0.65"
+    assert ndcg > floor, f"{task} valid ndcg@5 {ndcg} (ours ~0.658, reference ~0.63-0.65)"

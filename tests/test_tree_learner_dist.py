@@ -125,6 +125,40 @@ class TestVotingParallelE2E:
         auc_v = _auc(y, bst_v.predict(x, raw_score=True), None)
         assert auc_v > auc_s - 0.01
 
+    def test_full_vote_matches_serial(self, binary_data):
+        # contract: with top_k >= num_features the vote selects EVERY
+        # feature, the filtered psum degenerates to the full data-parallel
+        # reduction, and the tree must equal serial exactly — this pins
+        # the vote statistic's validity masks (min_data/min_hessian with
+        # the per-rank /num_machines rescale,
+        # voting_parallel_tree_learner.cpp:61-63): an over-strict local
+        # mask would veto features and break the equality
+        x, y = binary_data
+        bst_s = _train(BASE, x, y, nrounds=5)
+        bst_v = _train(dict(BASE, tree_learner="voting",
+                            top_k=x.shape[1]), x, y, nrounds=5)
+        _assert_same_model(bst_s, bst_v)
+
+    def test_local_constraint_rescale(self):
+        # min_data_in_leaf near the LOCAL shard size: unscaled local
+        # constraints would invalidate every candidate on every shard
+        # (8 shards x 500 rows; min_data_in_leaf=300 < 500 but every
+        # balanced local child has ~<300 rows), the vote would select
+        # arbitrary features and quality would collapse
+        rs = np.random.RandomState(13)
+        n, f = 4000, 12
+        x = rs.randn(n, f)
+        y = (x[:, 3] - x[:, 5] > 0).astype(np.float32)
+        bst = _train(dict(BASE, tree_learner="voting", top_k=2,
+                          min_data_in_leaf=300, num_leaves=4), x, y,
+                     nrounds=5)
+        from lightgbm_tpu.metrics import _auc
+        auc = _auc(y, bst.predict(x, raw_score=True), None)
+        assert auc > 0.9
+        used = {int(ft) for t in bst.trees
+                for ft in t.split_feature[:t.num_nodes()]}
+        assert used <= {3, 5}, f"voted splits on noise features: {used}"
+
 
 class TestVotingRootTotals:
     def test_unvoted_feature0_keeps_root_totals(self):
