@@ -489,6 +489,135 @@ class Booster:
         cfg.refit_decay_rate = decay_rate
         return _refit(new_booster, x, np.asarray(label, np.float32), cfg)
 
+    def refit_with_leaves(self, leaf_preds: np.ndarray) -> "Booster":
+        """GBDT::RefitTree with GIVEN per-tree leaf assignments
+        (LGBM_BoosterRefit, c_api.h:578; gbdt.cpp:287-323): re-fit every
+        tree's leaf values from the training labels' gradients at the
+        evolving score, blending with refit_decay_rate.  ``leaf_preds``
+        is [num_data, num_trees] (the pred_leaf layout)."""
+        if self.train_set is None:
+            raise ValueError("refit_with_leaves needs a booster with "
+                             "training data (LGBM_BoosterCreate)")
+        from .cli import refit_leaf_values
+        leaf_preds = np.asarray(leaf_preds, np.int32)
+        y = np.asarray(self.train_set.metadata.label, np.float32)
+        refit_leaf_values(self, leaf_preds, y, self.config)
+        # sync the model's cached state with the new leaf values (the
+        # reference RefitTree runs train_score_updater_->AddScore per
+        # tree, gbdt.cpp:320): device copies + the training score, so a
+        # following UpdateOneIter/GetPredict sees the refit model
+        m = getattr(self, "_model", None)
+        if m is not None:
+            import jax.numpy as jnp
+            k = self._num_tree_per_iteration
+            score = np.zeros((leaf_preds.shape[0], k), np.float64)
+            for ti, t in enumerate(self.trees):
+                if ti < len(m.device_trees):
+                    dt = m.device_trees[ti]
+                    lv = np.zeros(np.asarray(dt.leaf_value).shape[0],
+                                  np.float32)
+                    lv[:t.num_leaves] = t.leaf_value[:t.num_leaves]
+                    dt.leaf_value = jnp.asarray(lv)
+                w = m.tree_weights[ti] if ti < len(m.tree_weights) else 1.0
+                score[:, ti % k] += w * t.leaf_value[leaf_preds[:, ti]]
+            m.score = jnp.asarray(score, jnp.float32)
+        return self
+
+    def _merge_from(self, other: "Booster") -> None:
+        """LGBM_BoosterMerge (c_api.h:522): append other's trees."""
+        if other._num_tree_per_iteration != self._num_tree_per_iteration:
+            raise ValueError("cannot merge boosters with different "
+                             "num_tree_per_iteration")
+        import copy as _copy
+        new_trees = [_copy.deepcopy(t) for t in other.trees]
+        if self._model is not None:
+            self._model.models.extend(new_trees)
+            self._model.tree_weights.extend(
+                list(other.tree_weights) if other.tree_weights
+                else [1.0] * len(new_trees))
+            if hasattr(other, "_model") and other._model is not None \
+                    and len(other._model.device_trees) == len(new_trees):
+                self._model.device_trees.extend(other._model.device_trees)
+            self._model.iter_ += len(new_trees) \
+                // self._num_tree_per_iteration
+            self._sync_trees()
+        else:
+            self.trees.extend(new_trees)
+            self.tree_weights.extend(
+                list(other.tree_weights) if other.tree_weights
+                else [1.0] * len(new_trees))
+
+    def _shuffle_models(self, start_iter: int, end_iter: int) -> None:
+        """LGBM_BoosterShuffleModels (c_api.h:512; GBDT::ShuffleModels):
+        permute whole iterations in [start_iter, end_iter) (<=0 end =
+        all) with the data_random_seed stream."""
+        k = self._num_tree_per_iteration
+        trees = self.trees
+        n_iter = len(trees) // k
+        end_iter = n_iter if end_iter <= 0 else min(end_iter, n_iter)
+        start_iter = max(0, start_iter)
+        if end_iter - start_iter < 2:
+            return
+        rng = np.random.RandomState(self.config.data_random_seed
+                                    if hasattr(self, "config") else 1)
+        perm = np.arange(start_iter, end_iter)
+        rng.shuffle(perm)
+
+        def _permute(seq):
+            """Apply the same iteration-block permutation to any list
+            position-paired with the trees (weights, device trees)."""
+            if len(seq) != len(trees):
+                return seq             # not paired 1:1 — leave untouched
+            blocks = [seq[i * k:(i + 1) * k] for i in range(n_iter)]
+            shuffled = (blocks[:start_iter]
+                        + [blocks[i] for i in perm]
+                        + blocks[end_iter:])
+            return [t for b in shuffled for t in b]
+
+        new_trees = _permute(trees)
+        if self._model is not None:
+            m = self._model
+            m.tree_weights[:] = _permute(list(m.tree_weights))
+            if len(m.device_trees) == len(trees):
+                m.device_trees[:] = _permute(list(m.device_trees))
+            m.models[:] = new_trees
+            self._sync_trees()
+        else:
+            self.tree_weights[:] = _permute(list(self.tree_weights))
+            self.trees[:] = new_trees
+
+    def reset_training_data(self, train_set) -> "Booster":
+        """LGBM_BoosterResetTrainingData (c_api.h:540): keep the model,
+        continue training on a different dataset.  The training score is
+        rebuilt by predicting the new data with the current model."""
+        from .models import create_boosting
+        from .objectives import create_objective
+        import jax.numpy as jnp
+        old_models = self._model.models if self._model is not None \
+            else list(self.trees)
+        old_weights = self._model.tree_weights if self._model is not None \
+            else list(self.tree_weights)
+        old_iter = (self._model.iter_ if self._model is not None
+                    else len(old_models) // self._num_tree_per_iteration)
+        self.train_set = train_set.construct(self.config)
+        self._model = create_boosting(self.config, self.train_set,
+                                      create_objective(self.config))
+        m = self._model
+        m.models = list(old_models)
+        m.tree_weights = list(old_weights)
+        m.iter_ = old_iter
+        if old_models and self.train_set.raw_data is not None:
+            raw = np.asarray(self.train_set.raw_data, np.float64)
+            score = np.zeros((len(raw), self._num_tree_per_iteration),
+                             np.float64)
+            for ti, t in enumerate(old_models):
+                kk = ti % self._num_tree_per_iteration
+                w = old_weights[ti] if ti < len(old_weights) else 1.0
+                score[:, kk] += w * t.predict(raw)
+            m.score = jnp.asarray(score, jnp.float32)
+        self._sync_trees()
+        return self
+
     # ------------------------------------------------------------------
     def _load_model_string(self, s: str) -> None:
         """LoadModelFromString (gbdt_model_text.cpp:421)."""

@@ -259,10 +259,14 @@ class _StreamingDataset:
         self.params = _params(params)
         self.pending_fields: dict = {}
         self.ds: Optional[Dataset] = None
+        self.mappers = None            # CreateFromSampledColumn pre-fit
+        self.reference = None          # CreateByReference alignment
 
     def finish(self) -> Dataset:
         if self.ds is None:
-            self.ds = Dataset(self.buf[:self.filled], params=self.params)
+            self.ds = Dataset(self.buf[:self.filled], params=self.params,
+                              bin_mappers=self.mappers,
+                              reference=self.reference)
             for name, arr in self.pending_fields.items():
                 dataset_set_field(self.ds, name, memoryview(arr.tobytes()),
                                   len(arr),
@@ -634,3 +638,342 @@ def booster_train_num_data(bst: Booster) -> int:
     """Gradient buffer length for LGBM_BoosterUpdateOneIterCustom:
     num_data * num_class (c_api.h:589-595 contract)."""
     return int(bst._model.num_data * bst._model.num_class)
+
+
+# ---------------------------------------------------------------------------
+# The remaining reference entry points (c_api.h full-surface closure):
+# sampled-column/by-reference construction, subset, feature merge, text
+# dump, per-feature bin counts, model surgery (merge/shuffle/leaf get-set),
+# leaf-pred refit, reset-training-data, bound values, sparse-output
+# predict, param-alias dump, log forwarding.
+# ---------------------------------------------------------------------------
+
+def dump_param_aliases() -> str:
+    """LGBM_DumpParamAliases (c_api.h:62): JSON param -> [aliases]."""
+    import json
+    from .config import _PARAMS
+    return json.dumps({name: list(spec[2]) if len(spec) > 2 else []
+                       for name, spec in _PARAMS.items()})
+
+
+def sample_count(num_total_row: int, params: str) -> int:
+    """LGBM_GetSampleCount: min(bin_construct_sample_cnt, total)."""
+    p = _params(params)
+    cnt = int(p.get("bin_construct_sample_cnt", 200000))
+    return int(min(cnt, int(num_total_row)))
+
+
+def sample_indices(num_total_row: int, params: str, out_mv) -> int:
+    """LGBM_SampleIndices: the binning sample row ids (sorted, like the
+    reference's Random::Sample)."""
+    p = _params(params)
+    n = sample_count(num_total_row, params)
+    seed = int(p.get("data_random_seed", 1))
+    rng = np.random.RandomState(seed)
+    idx = np.sort(rng.choice(int(num_total_row), size=n, replace=False)
+                  .astype(np.int32))
+    out = np.frombuffer(out_mv, np.int32)
+    out[:n] = idx
+    return n
+
+
+def register_log_forward(addr: int) -> None:
+    """Route Log output to a C callback (LGBM_RegisterLogCallback)."""
+    import ctypes
+    from .utils import log as log_mod
+    if addr == 0:
+        log_mod._callback = None
+        return
+    cb = ctypes.CFUNCTYPE(None, ctypes.c_char_p)(int(addr))
+    log_mod._callback = lambda msg: cb(msg.encode())
+
+
+def dataset_create_from_sampled_column(cols, num_sample_row: int,
+                                       num_total_row: int,
+                                       params: str) -> "_StreamingDataset":
+    """LGBM_DatasetCreateFromSampledColumn (c_api.h:126): pre-size the
+    dataset and fit the bin mappers NOW from the per-column samples, so
+    pushed rows bin against a fixed layout (the reference streams the
+    same way); ``cols`` is a list of per-column sampled value arrays.
+    find_bin's total count is the SAMPLE size (zeros are inferred as
+    num_sample_row - len(col), not against the full dataset)."""
+    from .binning import BinMapper
+    from .config import Config
+    p = _params(params)
+    cfg = Config(p)
+    mappers = []
+    for vals in cols:
+        m = BinMapper()
+        m.find_bin(np.asarray(vals, np.float64), int(num_sample_row),
+                   cfg.max_bin, cfg.min_data_in_bin,
+                   use_missing=cfg.use_missing,
+                   zero_as_missing=cfg.zero_as_missing)
+        mappers.append(m)
+    sd = _StreamingDataset(num_total_row, len(cols), params)
+    sd.mappers = mappers
+    return sd
+
+
+def dataset_create_by_reference(ref, num_total_row: int) -> "_StreamingDataset":
+    """LGBM_DatasetCreateByReference (c_api.h:142): pre-sized streaming
+    dataset aligned to the reference's bin mappers."""
+    ref = _as_dataset(ref)
+    ref.construct()
+    sd = _StreamingDataset(num_total_row, ref.num_total_features, "")
+    sd.reference = ref
+    return sd
+
+
+def dataset_push_rows2(sd, mv, data_type: int, nrow: int, ncol: int,
+                       start_row: int) -> None:
+    """Typed LGBM_DatasetPushRows (c_api.h:156)."""
+    arr = _typed_matrix(mv, data_type, nrow, ncol, 1)
+    if sd.ds is not None:
+        raise ValueError("dataset already finalized")
+    sd.buf[int(start_row):int(start_row) + int(nrow), :int(ncol)] = arr
+    sd.filled = max(sd.filled, int(start_row) + int(nrow))
+
+
+def dataset_push_rows_by_csr2(sd, indptr_mv, indptr_type, indices_mv,
+                              data_mv, data_type, nindptr, nelem,
+                              start_row: int) -> None:
+    """Typed LGBM_DatasetPushRowsByCSR (c_api.h:177)."""
+    from scipy.sparse import csr_matrix
+    indptr, indices, data = _typed_sparse_parts(
+        indptr_mv, indptr_type, nindptr, indices_mv, data_mv, data_type,
+        nelem)
+    x = csr_matrix((data, indices, indptr),
+                   shape=(int(nindptr) - 1, sd.buf.shape[1])).toarray()
+    if sd.ds is not None:
+        raise ValueError("dataset already finalized")
+    sd.buf[int(start_row):int(start_row) + x.shape[0]] = x
+    sd.filled = max(sd.filled, int(start_row) + x.shape[0])
+
+
+def dataset_get_subset(ds, idx_mv, num: int, params: str):
+    """LGBM_DatasetGetSubset (c_api.h:313)."""
+    ds = _as_dataset(ds)
+    ds.construct()
+    idx = np.frombuffer(idx_mv, np.int32)[:int(num)].copy()
+    return ds.subset(idx)
+
+
+def dataset_add_features_from(target, source) -> None:
+    """LGBM_DatasetAddFeaturesFrom (c_api.h:452): append source's
+    feature columns to target (Dataset::AddFeaturesFrom)."""
+    t, s = _as_dataset(target), _as_dataset(source)
+    t.construct()
+    s.construct()
+    if t.num_data != s.num_data:
+        raise ValueError(
+            f"row mismatch: {t.num_data} vs {s.num_data}")
+    nt = t.num_total_features
+    t.binned = np.concatenate([t.feature_binned(), s.feature_binned()],
+                              axis=1)
+    t.bin_offsets = None
+    t.efb = None                       # bundles no longer match columns
+    t.bin_mappers = list(t.bin_mappers) + list(s.bin_mappers)
+    t.used_features = list(t.used_features) + [
+        nt + f for f in s.used_features]
+    t.num_total_features = nt + s.num_total_features
+    t.feature_names = list(t.feature_names) + list(s.feature_names)
+    if t.raw_data is not None and s.raw_data is not None \
+            and hasattr(t.raw_data, "shape") and hasattr(s.raw_data, "shape"):
+        t.raw_data = np.concatenate(
+            [np.asarray(t.raw_data), np.asarray(s.raw_data)], axis=1)
+    else:
+        t.raw_data = None
+
+
+def dataset_dump_text(ds, filename: str) -> None:
+    """LGBM_DatasetDumpText (c_api.h:371): binned values, one row per
+    line (the reference's debugging dump).  The header lists only the
+    USED features — feature_binned() has no columns for trivial ones."""
+    ds = _as_dataset(ds)
+    ds.construct()
+    binned = ds.feature_binned()
+    names = ds.feature_names or [
+        f"Column_{i}" for i in range(ds.num_total_features)]
+    used_names = [names[f] for f in ds.used_features]
+    with open(filename, "w") as f:
+        f.write("\t".join(used_names) + "\n")
+        for row in binned:
+            f.write("\t".join(str(int(v)) for v in row) + "\n")
+
+
+def dataset_update_param_checking(old_params: str, new_params: str) -> None:
+    """LGBM_DatasetUpdateParamChecking (c_api.h:414): raise when a
+    dataset-affecting parameter changed (config.cpp dataset param set).
+    Compared on RESOLVED Config values (aliases applied, absent keys at
+    their defaults) like the reference — an explicit value equal to the
+    default is not a change."""
+    from .config import Config
+    dataset_keys = (
+        "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+        "use_missing", "zero_as_missing", "categorical_feature",
+        "feature_pre_filter", "enable_bundle", "data_random_seed",
+        "is_enable_sparse", "header", "two_round", "label_column",
+        "weight_column", "group_column", "ignore_column",
+        "forcedbins_filename", "precise_float_parser",
+        "max_conflict_rate", "linear_tree")
+    o, n = Config(_params(old_params)), Config(_params(new_params))
+    changed = [k for k in dataset_keys
+               if getattr(o, k, None) != getattr(n, k, None)]
+    if changed:
+        raise ValueError(
+            "cannot change dataset parameters after construction: "
+            + ", ".join(changed))
+
+
+def dataset_feature_num_bin(ds, feature: int) -> int:
+    """LGBM_DatasetGetFeatureNumBin (c_api.h:442).  ``bin_mappers`` is
+    indexed by TOTAL feature id (trivial features keep their single-bin
+    mapper), not by used-feature slot."""
+    ds = _as_dataset(ds)
+    ds.construct()
+    f = int(feature)
+    if not 0 <= f < len(ds.bin_mappers):
+        raise ValueError(f"feature index {f} out of range "
+                         f"({len(ds.bin_mappers)} features)")
+    return int(ds.bin_mappers[f].num_bin)
+
+
+def booster_get_linear(bst: Booster) -> int:
+    return 1 if getattr(bst.config, "linear_tree", False) else 0
+
+
+def booster_get_leaf_value(bst: Booster, tree_idx: int,
+                           leaf_idx: int) -> float:
+    return float(bst.trees[int(tree_idx)].leaf_value[int(leaf_idx)])
+
+
+def booster_set_leaf_value(bst: Booster, tree_idx: int, leaf_idx: int,
+                           val: float) -> None:
+    """LGBM_BoosterSetLeafValue (Tree::SetLeafOutput): updates the host
+    tree and, when the booster is mid-training, its device copy (train/
+    valid score caches are NOT retro-adjusted — same as the reference,
+    which applies the new value from the next AddScore on)."""
+    bst.trees[int(tree_idx)].leaf_value[int(leaf_idx)] = float(val)
+    m = getattr(bst, "_model", None)
+    if m is not None and int(tree_idx) < len(getattr(m, "device_trees", [])):
+        import jax.numpy as jnp
+        dt = m.device_trees[int(tree_idx)]
+        lv = np.asarray(dt.leaf_value).copy()
+        lv[int(leaf_idx)] = float(val)
+        dt.leaf_value = jnp.asarray(lv, jnp.float32)
+
+
+def booster_merge(bst: Booster, other: Booster) -> None:
+    """LGBM_BoosterMerge (c_api.h:522): append other's models."""
+    bst._merge_from(other)
+
+
+def booster_shuffle_models(bst: Booster, start_iter: int,
+                           end_iter: int) -> None:
+    bst._shuffle_models(int(start_iter), int(end_iter))
+
+
+def booster_num_predict(bst: Booster, data_idx: int) -> int:
+    m = bst._model
+    if int(data_idx) == 0:
+        n = m.num_data
+    else:
+        i = int(data_idx) - 1
+        if i >= len(m.valid_sets):
+            raise ValueError(f"data_idx {data_idx} out of range")
+        n = m.valid_sets[i][0].num_data
+    return int(n * m.num_class)
+
+
+def booster_get_predict(bst: Booster, data_idx: int, out_mv) -> int:
+    """LGBM_BoosterGetPredict (c_api.h:728): transformed scores for the
+    train (0) / valid (i>=1) data."""
+    import jax.numpy as jnp
+    m = bst._model
+    if int(data_idx) == 0:
+        score = m.train_score()
+    else:
+        score = m.valid_score(int(data_idx) - 1)
+    score = np.asarray(score)
+    if m.objective is not None:
+        s = score[:, 0] if m.num_class == 1 else score
+        score = np.asarray(m.objective.convert_output(jnp.asarray(s)))
+        score = score.reshape(len(score), -1)
+    flat = np.ascontiguousarray(score.astype(np.float64)).reshape(-1)
+    out = np.frombuffer(out_mv, np.float64)
+    if len(flat) > len(out):
+        raise ValueError("output buffer too small")
+    out[:len(flat)] = flat
+    return int(len(flat))
+
+
+def booster_reset_training_data(bst: Booster, ds) -> None:
+    bst.reset_training_data(_as_dataset(ds))
+
+
+def booster_refit_leaf_preds(bst: Booster, leaf_mv, nrow: int,
+                             ncol: int) -> None:
+    """LGBM_BoosterRefit (c_api.h:578): re-fit leaf values from given
+    per-tree leaf assignments (GBDT::RefitTree, gbdt.cpp:287-323) using
+    the booster's training data labels."""
+    leaves = np.frombuffer(leaf_mv, np.int32)[:int(nrow) * int(ncol)] \
+        .reshape(int(nrow), int(ncol)).copy()
+    bst.refit_with_leaves(leaves)
+
+
+def booster_upper_bound(bst: Booster) -> float:
+    return float(sum(float(np.max(t.leaf_value[:max(t.num_leaves, 1)]))
+                     for t in bst.trees))
+
+
+def booster_lower_bound(bst: Booster) -> float:
+    return float(sum(float(np.min(t.leaf_value[:max(t.num_leaves, 1)]))
+                     for t in bst.trees))
+
+
+def booster_predict_csc2(bst: Booster, colptr_mv, colptr_type, indices_mv,
+                         data_mv, data_type, ncol_ptr, nelem, nrow,
+                         predict_type: int, start_iteration: int,
+                         num_iteration: int, out_mv) -> int:
+    from scipy.sparse import csc_matrix
+    colptr, indices, data = _typed_sparse_parts(
+        colptr_mv, colptr_type, ncol_ptr, indices_mv, data_mv, data_type,
+        nelem)
+    x = csc_matrix((data, indices, colptr),
+                   shape=(int(nrow), int(ncol_ptr) - 1)).tocsr()
+    return _predict_out(bst, x, predict_type, start_iteration,
+                        num_iteration, out_mv)
+
+
+def booster_predict_sparse(bst: Booster, indptr_mv, indptr_type,
+                           indices_mv, data_mv, data_type, nindptr, nelem,
+                           num_col_or_row, predict_type: int,
+                           start_iteration: int, num_iteration: int,
+                           matrix_type: int):
+    """LGBM_BoosterPredictSparseOutput (c_api.h:859): contrib
+    predictions as sparse CSR (matrix_type 0) / CSC (1) triples.
+    Returns (indptr int64 array, indices int32 array, data float64
+    array) pinned on the booster until the next call."""
+    from scipy.sparse import csr_matrix, csc_matrix
+    indptr, indices, data = _typed_sparse_parts(
+        indptr_mv, indptr_type, nindptr, indices_mv, data_mv, data_type,
+        nelem)
+    x = csr_matrix((data, indices, indptr),
+                   shape=(int(nindptr) - 1, int(num_col_or_row)))
+    dense = _predict_dispatch(bst, x, predict_type, start_iteration,
+                              num_iteration)
+    dense = dense.reshape(x.shape[0], -1)
+    out = csc_matrix(dense) if int(matrix_type) == 1 else csr_matrix(dense)
+    # output buffers TYPED to the caller's input types, like the
+    # reference (c_api.cpp:504-507): int32/int64 indptr, f32/f64 data
+    trip = (np.ascontiguousarray(out.indptr, _NP_OF[int(indptr_type)]),
+            np.ascontiguousarray(out.indices, np.int32),
+            np.ascontiguousarray(out.data, _NP_OF[int(data_type)]))
+    bst._sparse_out = trip             # keep buffers alive for the caller
+    return (int(trip[0].ctypes.data), int(trip[0].size),
+            int(trip[1].ctypes.data),
+            int(trip[2].ctypes.data), int(trip[2].size))
+
+
+def booster_get_feature_names(bst: Booster) -> str:
+    return "\t".join(bst.feature_names or [])

@@ -133,12 +133,12 @@ def _task_convert_model(cfg: Config, params: Dict) -> int:
     return 0
 
 
-def refit(booster: Booster, x: np.ndarray, y: np.ndarray,
-          cfg: Config) -> Booster:
-    """Re-fit leaf values of an existing structure on new data
-    (GBDT::RefitTree, gbdt.cpp:287-323): per tree, route rows to leaves,
-    recompute the regularized optimal output from the new gradients, and
-    blend with ``refit_decay_rate``."""
+def refit_leaf_values(booster: Booster, leaf_preds: np.ndarray,
+                      y: np.ndarray, cfg: Config) -> Booster:
+    """GBDT::RefitTree core (gbdt.cpp:287-323) from GIVEN per-tree leaf
+    assignments [N, num_trees]: per tree, recompute the regularized
+    optimal output from the gradients at the evolving score, blended
+    with ``refit_decay_rate`` (FitByExistingTree)."""
     if any(t.is_linear for t in booster.trees):
         raise ValueError(
             "refit is not supported for linear-tree models: only the "
@@ -155,13 +155,17 @@ def refit(booster: Booster, x: np.ndarray, y: np.ndarray,
     score = np.zeros((len(y), k), np.float64)
     decay = cfg.refit_decay_rate
     lam = booster.config.lambda_l2
+    if leaf_preds.shape != (len(y), len(booster.trees)):
+        raise ValueError(
+            f"leaf_preds shape {leaf_preds.shape} != "
+            f"({len(y)}, {len(booster.trees)})")
     for ti, tree in enumerate(booster.trees):
         kk = ti % k
         g, h = obj.get_gradients(jnp.asarray(score[:, kk], jnp.float32)
                                  if k == 1 else jnp.asarray(score, jnp.float32))
         g = np.asarray(g).reshape(len(y), -1)[:, kk]
         h = np.asarray(h).reshape(len(y), -1)[:, kk]
-        leaves = tree.predict_leaf(x)
+        leaves = leaf_preds[:, ti]
         for leaf in range(tree.num_leaves):
             m = leaves == leaf
             if not m.any():
@@ -172,6 +176,16 @@ def refit(booster: Booster, x: np.ndarray, y: np.ndarray,
                                      * tree.shrinkage)
         score[:, kk] += tree.leaf_value[leaves]
     return booster
+
+
+def refit(booster: Booster, x: np.ndarray, y: np.ndarray,
+          cfg: Config) -> Booster:
+    """Re-fit leaf values of an existing structure on new data
+    (GBDT::RefitTree, gbdt.cpp:287-323): route rows to leaves, then
+    re-fit from the assignments."""
+    leaf_preds = np.stack([t.predict_leaf(x) for t in booster.trees],
+                          axis=1).astype(np.int32)
+    return refit_leaf_values(booster, leaf_preds, y, cfg)
 
 
 def main() -> int:

@@ -656,11 +656,27 @@ class GBDTModel:
         if valid.metadata.init_score is not None:
             init += np.asarray(valid.metadata.init_score, np.float32) \
                 .reshape(valid.num_data, -1)
+        # models without device copies (reset_training_data installed an
+        # existing ensemble): fold their contribution in by host
+        # prediction on the raw values; device_trees always corresponds
+        # to the TAIL of models
+        n_host_only = len(self.models) - len(self.device_trees)
+        if n_host_only > 0:
+            if valid.raw_data is None:
+                raise ValueError(
+                    "validation after reset_training_data needs the valid "
+                    "set's raw values (free_raw_data=False)")
+            raw = np.asarray(valid.raw_data, np.float64)
+            for ti in range(n_host_only):
+                k = ti % self.num_class
+                init[:, k] += (self.tree_weights[ti]
+                               * self.models[ti].predict(raw))
         score = jnp.asarray(init)
-        # replay existing trees (continued training)
+        # replay existing device trees (continued training)
         for ti, dt in enumerate(self.device_trees):
-            k = ti % self.num_class
-            ht = self.models[ti] if ti < len(self.models) else None
+            mi = n_host_only + ti
+            k = mi % self.num_class
+            ht = self.models[mi] if mi < len(self.models) else None
             if ht is not None and ht.is_linear:
                 leaves = np.asarray(traverse_tree_binned(
                     binned, dt.split_feature, dt.threshold_bin,
@@ -669,11 +685,11 @@ class GBDTModel:
                     self.efb_maps, steps=dt.steps))
                 delta = self._linear_outputs(ht, leaves, valid.raw_data)
                 score = score.at[:, k].add(
-                    self.tree_weights[ti] * jnp.asarray(delta, jnp.float32))
+                    self.tree_weights[mi] * jnp.asarray(delta, jnp.float32))
             else:
                 score = score.at[:, k].set(_apply_tree(
                     score[:, k], binned, dt, self.na_bin_dev,
-                    self.tree_weights[ti], self.efb_maps))
+                    self.tree_weights[mi], self.efb_maps))
         self.valid_sets.append((valid, binned, score))
 
     # -- sampling (gbdt.cpp:230 Bagging + goss.hpp) ------------------------

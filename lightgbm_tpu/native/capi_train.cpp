@@ -18,6 +18,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <cstdlib>
+#include <functional>
 #include <fstream>
 #include <string>
 #include <vector>
@@ -1172,13 +1174,13 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
 // FastConfig single-row fast path (c_api.h:1141-1196): freeze the predict
 // configuration once; per-call work is one bridge hop with the frozen
 // arguments.
-struct FastConfig {
+struct FastConfig {        // shared by the Mat and CSR single-row paths
   PyObject* booster;
   int predict_type;
   int start_iteration;
   int num_iteration;
   int data_type;
-  int32_t ncol;
+  int64_t ncol;
   int64_t cap;  // pre-computed output capacity (doubles)
 };
 typedef void* FastConfigHandle;
@@ -1249,5 +1251,708 @@ int LGBM_NetworkInit(const char* machines, int local_listen_port,
                                num_machines);
 }
 int LGBM_NetworkFree() { return LGBM_TrainNetworkFree(); }
+
+// ---------------------------------------------------------------------------
+// Full-surface closure: the remaining c_api.h entry points (sampled-column
+// / by-reference streaming, subset, feature merge, dumps, model surgery,
+// leaf-pred refit, sparse-output predict, utility calls).
+// ---------------------------------------------------------------------------
+
+// split a tab-joined bridge string into the reference's (len, out_len,
+// buffer_len, out_buffer_len, out_strs) string-list contract
+static int StrListOut(const std::string& all, const int len, int* out_len,
+                      const size_t buffer_len, size_t* out_buffer_len,
+                      char** out_strs) {
+  std::vector<std::string> names;
+  if (!all.empty()) {
+    size_t pos = 0;
+    while (true) {
+      size_t t = all.find('\t', pos);
+      names.push_back(all.substr(pos, t == std::string::npos
+                                          ? std::string::npos : t - pos));
+      if (t == std::string::npos) break;
+      pos = t + 1;
+    }
+  }
+  if (out_len) *out_len = (int)names.size();
+  size_t need = 1;
+  for (const auto& s : names) need = s.size() + 1 > need ? s.size() + 1 : need;
+  if (out_buffer_len) *out_buffer_len = need;
+  if (out_strs) {
+    int n = (int)names.size() < len ? (int)names.size() : len;
+    for (int i = 0; i < n; ++i) {
+      if (!out_strs[i] || buffer_len == 0) continue;
+      size_t c = names[i].size() + 1 < buffer_len ? names[i].size() + 1
+                                                  : buffer_len;
+      std::memcpy(out_strs[i], names[i].c_str(), c - 1);
+      out_strs[i][c - 1] = '\0';
+    }
+  }
+  return 0;
+}
+
+// bridge call returning a string copied through (buffer_len, out_len,
+// out_str)
+static int StrCall(const char* fn, PyObject* args, int64_t buffer_len,
+                   int64_t* out_len, char* out_str) {
+  PyObject* r = Call(fn, args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  int rc = StrOut(r, buffer_len, out_len, out_str);
+  Py_DECREF(r);
+  return rc;
+}
+
+int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
+                          char* out_str) {
+  Gil gil;
+  return StrCall("dump_param_aliases", Py_BuildValue("()"), buffer_len,
+                 out_len, out_str);
+}
+
+int LGBM_RegisterLogCallback(void (*callback)(const char*)) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)",
+                                 (long long)(intptr_t)callback);
+  PyObject* r = Call("register_log_forward", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_GetSampleCount(int32_t num_total_row, const char* parameters,
+                        int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(is)", (int)num_total_row,
+                                 parameters ? parameters : "");
+  PyObject* r = Call("sample_count", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out) *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_SampleIndices(int32_t num_total_row, const char* parameters,
+                       void* out, int32_t* out_len) {
+  Gil gil;
+  PyObject* mv = View(out, (Py_ssize_t)num_total_row * 4, true);
+  PyObject* args = Py_BuildValue("(isO)", (int)num_total_row,
+                                 parameters ? parameters : "", mv);
+  Py_DECREF(mv);
+  PyObject* r = Call("sample_indices", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out_len) *out_len = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out) {
+  (void)sample_indices;
+  Gil gil;
+  PyObject* cols = PyList_New(ncol);
+  if (!cols) return PyError();
+  for (int32_t j = 0; j < ncol; ++j) {
+    PyObject* mv = View(sample_data[j],
+                        (Py_ssize_t)num_per_col[j] * 8);
+    PyObject* arr = Py_BuildValue("O", mv);  // keep as memoryview
+    Py_DECREF(mv);
+    PyList_SET_ITEM(cols, j, arr);
+  }
+  PyObject* args = Py_BuildValue("(OLLs)", cols,
+                                 (long long)num_sample_row,
+                                 (long long)num_total_row,
+                                 parameters ? parameters : "");
+  Py_DECREF(cols);
+  PyObject* r = Call("dataset_create_from_sampled_column", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row,
+                                  DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OL)", RefOrNone(
+                                     const_cast<DatasetHandle>(reference)),
+                                 (long long)num_total_row);
+  PyObject* r = Call("dataset_create_by_reference", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row) {
+  Gil gil;
+  PyObject* mv = View(data, (Py_ssize_t)nrow * ncol * DtypeSize(data_type));
+  PyObject* args = Py_BuildValue("(OOiiii)",
+                                 reinterpret_cast<PyObject*>(dataset), mv,
+                                 data_type, (int)nrow, (int)ncol,
+                                 (int)start_row);
+  Py_DECREF(mv);
+  PyObject* r = Call("dataset_push_rows2", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row) {
+  (void)num_col;
+  Gil gil;
+  PyObject* ip = View(indptr, nindptr * DtypeSize(indptr_type));
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * DtypeSize(data_type));
+  PyObject* args = Py_BuildValue(
+      "(OOiOOiLLL)", reinterpret_cast<PyObject*>(dataset), ip, indptr_type,
+      ix, dv, data_type, (long long)nindptr, (long long)nelem,
+      (long long)start_row);
+  Py_DECREF(ip);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  PyObject* r = Call("dataset_push_rows_by_csr2", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out) {
+  Gil gil;
+  PyObject* mv = View(used_row_indices,
+                      (Py_ssize_t)num_used_row_indices * 4);
+  PyObject* args = Py_BuildValue(
+      "(OOis)", reinterpret_cast<PyObject*>(
+          const_cast<DatasetHandle>(handle)),
+      mv, (int)num_used_row_indices, parameters ? parameters : "");
+  Py_DECREF(mv);
+  PyObject* r = Call("dataset_get_subset", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  *out = r;
+  return 0;
+}
+
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Os)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 filename);
+  PyObject* r = Call("dataset_dump_text", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                    const char* new_parameters) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(ss)",
+                                 old_parameters ? old_parameters : "",
+                                 new_parameters ? new_parameters : "");
+  PyObject* r = Call("dataset_update_param_checking", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature,
+                                 int* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 feature);
+  PyObject* r = Call("dataset_feature_num_bin", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out) *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                DatasetHandle source) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)",
+                                 reinterpret_cast<PyObject*>(target),
+                                 reinterpret_cast<PyObject*>(source));
+  PyObject* r = Call("dataset_add_features_from", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+static int NamesFromBridge(PyObject* handle, const char* fn, const int len,
+                           int* out_len, const size_t buffer_len,
+                           size_t* out_buffer_len, char** out_strs) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle);
+  PyObject* r = Call(fn, args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  std::string all(SafeUTF8(r, ""));
+  Py_DECREF(r);
+  return StrListOut(all, len, out_len, buffer_len, out_buffer_len,
+                    out_strs);
+}
+
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  return NamesFromBridge(reinterpret_cast<PyObject*>(handle),
+                         "dataset_get_feature_names", len, out_len,
+                         buffer_len, out_buffer_len, out_strs);
+}
+
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, const int len,
+                                int* out_len, const size_t buffer_len,
+                                size_t* out_buffer_len, char** out_strs) {
+  return NamesFromBridge(reinterpret_cast<PyObject*>(handle),
+                         "booster_get_feature_names", len, out_len,
+                         buffer_len, out_buffer_len, out_strs);
+}
+
+int LGBM_BoosterGetLinear(BoosterHandle handle, int* out) {
+  return IntFromBridge(handle, "booster_get_linear", out);
+}
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double* out_val) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oii)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 tree_idx, leaf_idx);
+  PyObject* r = Call("booster_get_leaf_value", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out_val) *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                             int leaf_idx, double val) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oiid)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 tree_idx, leaf_idx, val);
+  PyObject* r = Call("booster_set_leaf_value", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 data_idx);
+  PyObject* r = Call("booster_num_predict", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out_len) *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result) {
+  Gil gil;
+  int64_t cap = 0;
+  if (LGBM_BoosterGetNumPredict(handle, data_idx, &cap) != 0) return -1;
+  PyObject* mv = View(out_result, (cap > 0 ? cap : 1) * 8, true);
+  PyObject* args = Py_BuildValue("(OiO)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 data_idx, mv);
+  Py_DECREF(mv);
+  PyObject* r = Call("booster_get_predict", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out_len) *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int start_iteration,
+                               int num_iteration, int64_t* out_len) {
+  int nc = 1, nf = 0, iters = 0;
+  (void)LGBM_TrainBoosterGetNumClasses(handle, &nc);
+  (void)IntFromBridge(handle, "booster_num_feature", &nf);
+  (void)LGBM_TrainBoosterGetCurrentIteration(handle, &iters);
+  int used = num_iteration > 0
+                 ? (num_iteration < iters - start_iteration
+                        ? num_iteration : iters - start_iteration)
+                 : iters - start_iteration;
+  if (used < 0) used = 0;
+  int64_t per_row = nc;
+  if (predict_type == 2) per_row = (int64_t)nc * used;
+  if (predict_type == 3) per_row = (int64_t)nc * (nf + 1);
+  if (out_len) *out_len = (int64_t)num_row * per_row;
+  return 0;
+}
+
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 reinterpret_cast<PyObject*>(other_handle));
+  PyObject* r = Call("booster_merge", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oii)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 start_iter, end_iter);
+  PyObject* r = Call("booster_shuffle_models", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OO)", reinterpret_cast<PyObject*>(handle),
+      reinterpret_cast<PyObject*>(const_cast<DatasetHandle>(train_data)));
+  PyObject* r = Call("booster_reset_training_data", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol) {
+  Gil gil;
+  PyObject* mv = View(leaf_preds, (Py_ssize_t)nrow * ncol * 4);
+  PyObject* args = Py_BuildValue("(OOii)",
+                                 reinterpret_cast<PyObject*>(handle), mv,
+                                 (int)nrow, (int)ncol);
+  Py_DECREF(mv);
+  PyObject* r = Call("booster_refit_leaf_preds", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  Py_DECREF(r);
+  return 0;
+}
+
+static int DoubleFromBridge(BoosterHandle handle, const char* fn,
+                            double* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(handle));
+  PyObject* r = Call(fn, args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out) *out = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  return DoubleFromBridge(handle, "booster_upper_bound", out_results);
+}
+int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                   double* out_results) {
+  return DoubleFromBridge(handle, "booster_lower_bound", out_results);
+}
+
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result) {
+  (void)parameter;
+  Gil gil;
+  PyObject* cp = View(col_ptr, ncol_ptr * DtypeSize(col_ptr_type));
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * DtypeSize(data_type));
+  int nf = 0, nc = 1, iters = 0;
+  (void)IntFromBridge(handle, "booster_num_feature", &nf);
+  (void)LGBM_TrainBoosterGetNumClasses(handle, &nc);
+  (void)LGBM_TrainBoosterGetCurrentIteration(handle, &iters);
+  int64_t cap = num_row * (nf + 1) * (nc > 0 ? nc : 1);
+  int64_t leaf_cap = num_row * (nc > 0 ? nc : 1) * (iters > 0 ? iters : 1);
+  if (leaf_cap > cap) cap = leaf_cap;
+  PyObject* out_mv = View(out_result, cap * 8, true);
+  PyObject* args = Py_BuildValue(
+      "(OOiOOiLLLiiiO)", reinterpret_cast<PyObject*>(handle), cp,
+      col_ptr_type, ix, dv, data_type, (long long)ncol_ptr,
+      (long long)nelem, (long long)num_row, predict_type, start_iteration,
+      num_iteration, out_mv);
+  Py_DECREF(cp);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  Py_DECREF(out_mv);
+  PyObject* r = Call("booster_predict_csc2", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out_len) *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow, int32_t ncol,
+                               int predict_type, int start_iteration,
+                               int num_iteration, const char* parameter,
+                               int64_t* out_len, double* out_result) {
+  // assemble the row pointers into one contiguous f64 matrix, then the
+  // regular mat path
+  std::vector<double> buf((size_t)nrow * ncol);
+  for (int32_t i = 0; i < nrow; ++i) {
+    if (data_type == 0) {
+      const float* row = reinterpret_cast<const float*>(data[i]);
+      for (int32_t j = 0; j < ncol; ++j) buf[(size_t)i * ncol + j] = row[j];
+    } else {
+      const double* row = reinterpret_cast<const double*>(data[i]);
+      for (int32_t j = 0; j < ncol; ++j) buf[(size_t)i * ncol + j] = row[j];
+    }
+  }
+  return LGBM_BoosterPredictForMat(handle, buf.data(), /*f64*/ 1, nrow,
+                                   ncol, 1, predict_type, start_iteration,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
+}
+
+// CSR FastConfig single-row path (c_api.h:953-1018) — reuses the SAME
+// FastConfig struct as the Mat variant so LGBM_FastConfigFree handles
+// both uniformly
+int LGBM_BoosterPredictForCSRSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int64_t num_col,
+    const char* parameter, FastConfigHandle* out_fastConfig) {
+  return LGBM_BoosterPredictForMatSingleRowFastInit(
+      handle, predict_type, start_iteration, num_iteration, data_type,
+      (int32_t)num_col, parameter, out_fastConfig);
+}
+
+int LGBM_BoosterPredictForCSRSingleRowFast(
+    FastConfigHandle fastConfig_handle, const void* indptr,
+    const int indptr_type, const int32_t* indices, const void* data,
+    const int64_t nindptr, const int64_t nelem, int64_t* out_len,
+    double* out_result) {
+  FastConfig* fc = reinterpret_cast<FastConfig*>(fastConfig_handle);
+  if (!fc) return SetError("null FastConfig handle");
+  Gil gil;
+  PyObject* ip = View(indptr, nindptr * DtypeSize(indptr_type));
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * DtypeSize(fc->data_type));
+  PyObject* out_mv = View(out_result, fc->cap * 8, true);
+  PyObject* args = Py_BuildValue(
+      "(OOiOOiLLLiiiO)", fc->booster, ip, indptr_type, ix, dv,
+      fc->data_type, (long long)nindptr, (long long)nelem,
+      (long long)fc->ncol, fc->predict_type, fc->start_iteration,
+      fc->num_iteration, out_mv);
+  Py_DECREF(ip);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  Py_DECREF(out_mv);
+  PyObject* r = Call("booster_predict_csr2", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  if (out_len) *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int LGBM_BoosterPredictSparseOutput(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col_or_row,
+    int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, int matrix_type, int64_t* out_len,
+    void** out_indptr, int32_t** out_indices, void** out_data) {
+  (void)parameter;
+  Gil gil;
+  PyObject* ip = View(indptr, nindptr * DtypeSize(indptr_type));
+  PyObject* ix = View(indices, nelem * 4);
+  PyObject* dv = View(data, nelem * DtypeSize(data_type));
+  PyObject* args = Py_BuildValue(
+      "(OOiOOiLLLiiii)", reinterpret_cast<PyObject*>(handle), ip,
+      indptr_type, ix, dv, data_type, (long long)nindptr, (long long)nelem,
+      (long long)num_col_or_row, predict_type, start_iteration,
+      num_iteration, matrix_type);
+  Py_DECREF(ip);
+  Py_DECREF(ix);
+  Py_DECREF(dv);
+  PyObject* r = Call("booster_predict_sparse", args);
+  Py_DECREF(args);
+  if (!r) return PyError();
+  // (indptr_addr, indptr_len, indices_addr, data_addr, data_len) — the
+  // backing numpy buffers are pinned on the booster; copy into malloc'd
+  // buffers the caller frees with LGBM_BoosterFreePredictSparse
+  long long pa, pl, ia, da, dl;
+  if (!PyArg_ParseTuple(r, "LLLLL", &pa, &pl, &ia, &da, &dl)) {
+    Py_DECREF(r);
+    return PyError();
+  }
+  Py_DECREF(r);
+  // buffers typed to the CALLER's indptr/data types (the bridge already
+  // produced matching numpy dtypes), per the reference contract
+  size_t ip_sz = DtypeSize(indptr_type), dt_sz = DtypeSize(data_type);
+  void* oip = malloc(ip_sz * pl);
+  int32_t* oix = static_cast<int32_t*>(malloc(sizeof(int32_t) * dl));
+  void* odt = malloc(dt_sz * dl);
+  if (!oip || !oix || !odt) {
+    free(oip);
+    free(oix);
+    free(odt);
+    return SetError("out of memory");
+  }
+  std::memcpy(oip, reinterpret_cast<void*>(pa), ip_sz * pl);
+  std::memcpy(oix, reinterpret_cast<void*>(ia), sizeof(int32_t) * dl);
+  std::memcpy(odt, reinterpret_cast<void*>(da), dt_sz * dl);
+  if (out_len) {
+    out_len[0] = dl;   // nnz
+    out_len[1] = pl;   // indptr length
+  }
+  if (out_indptr) *out_indptr = oip;
+  if (out_indices) *out_indices = oix;
+  if (out_data) *out_data = odt;
+  return 0;
+}
+
+int LGBM_BoosterFreePredictSparse(void* indptr, int32_t* indices,
+                                  void* data, int indptr_type,
+                                  int data_type) {
+  (void)indptr_type;
+  (void)data_type;
+  free(indptr);
+  free(indices);
+  free(data);
+  return 0;
+}
+
+int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem, num_col,
+                                   predict_type, start_iteration,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
+}
+
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                               int data_type, int32_t* nrow, int32_t ncol,
+                               int is_row_major, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out) {
+  // concatenate the row-blocks into one f64 matrix, then the mat path
+  int64_t total = 0;
+  for (int32_t m = 0; m < nmat; ++m) total += nrow[m];
+  std::vector<double> buf((size_t)total * ncol);
+  int64_t at = 0;
+  for (int32_t m = 0; m < nmat; ++m) {
+    for (int32_t i = 0; i < nrow[m]; ++i) {
+      for (int32_t j = 0; j < ncol; ++j) {
+        size_t src = is_row_major ? (size_t)i * ncol + j
+                                  : (size_t)j * nrow[m] + i;
+        double v = data_type == 0
+                       ? (double)reinterpret_cast<const float*>(data[m])[src]
+                       : reinterpret_cast<const double*>(data[m])[src];
+        buf[(size_t)(at + i) * ncol + j] = v;
+      }
+    }
+    at += nrow[m];
+  }
+  return LGBM_DatasetCreateFromMat(buf.data(), /*f64*/ 1, (int32_t)total,
+                                   ncol, 1, parameters, reference, out);
+}
+
+// NetworkInitWithFunctions (c_api.h:1350): the reference lets external
+// launchers inject reduce-scatter/allgather implementations.  The TPU
+// framework's collectives are XLA's own (compiled into the program), so
+// external function injection cannot replace them; accept the call for
+// link compatibility when the caller only needs rank bookkeeping, and
+// fail loudly if custom collectives were actually expected to be used.
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun) {
+  if (num_machines <= 1) return 0;
+  if (reduce_scatter_ext_fun || allgather_ext_fun) {
+    return SetError(
+        "LGBM_NetworkInitWithFunctions: external collective functions "
+        "cannot be injected into the XLA runtime (collectives are "
+        "compiled); use LGBM_NetworkInit with a machine list instead");
+  }
+  (void)rank;
+  return 0;
+}
+
+// CSRFunc: the caller hands a pointer to a C++
+// std::function<void(int, std::vector<std::pair<int, double>>&)> (the
+// reference's documented contract, c_api.h:226-236) — same-toolchain
+// assumption as the reference itself makes
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  const DatasetHandle reference,
+                                  DatasetHandle* out) {
+  using RowFn = std::function<void(int, std::vector<std::pair<int, double>>&)>;
+  RowFn* fn = reinterpret_cast<RowFn*>(get_row_funptr);
+  std::vector<int64_t> indptr(1, 0);
+  std::vector<int32_t> idx;
+  std::vector<double> vals;
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    (*fn)(i, row);
+    for (const auto& kv : row) {
+      idx.push_back(kv.first);
+      vals.push_back(kv.second);
+    }
+    indptr.push_back(static_cast<int64_t>(idx.size()));
+  }
+  if (idx.empty()) {               // keep the buffers non-null for View
+    idx.push_back(0);
+    vals.push_back(0.0);
+  }
+  return LGBM_DatasetCreateFromCSR(indptr.data(), /*int64*/ 3, idx.data(),
+                                   vals.data(), /*f64*/ 1,
+                                   (int64_t)indptr.size(),
+                                   (int64_t)(indptr.back()), num_col,
+                                   parameters, reference, out);
+}
 
 }  // extern "C"
