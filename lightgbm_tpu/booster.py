@@ -99,11 +99,8 @@ class Booster:
         self.feature_names = list(self.train_set.feature_names)
         self._max_feature_idx = self.train_set.num_total_features - 1
 
-        for name in self.config.default_metric():
-            m = create_metric(name, self.config)
-            if m is not None:
-                m.init(self.train_set.metadata, self.train_set.num_data)
-                self._train_metrics.append(m)
+        self._train_metrics = self._make_metrics(self.train_set.metadata,
+                                                 self.train_set.num_data)
 
     # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -113,14 +110,19 @@ class Booster:
         data.construct(self.config)
         self._model.add_valid_set(data)
         self._valid_names.append(name)
+        self._valid_metrics.append(self._make_metrics(data.metadata,
+                                                      data.num_data))
+        return self
+
+    def _make_metrics(self, metadata, num_data) -> List:
+        """Configured metric objects bound to one dataset's metadata."""
         ms = []
         for mname in self.config.default_metric():
             m = create_metric(mname, self.config)
             if m is not None:
-                m.init(data.metadata, data.num_data)
+                m.init(metadata, num_data)
                 ms.append(m)
-        self._valid_metrics.append(ms)
-        return self
+        return ms
 
     def update(self, train_set=None, fobj=None) -> bool:
         """One boosting iteration; returns True if no further splits
@@ -216,7 +218,8 @@ class Booster:
     # ------------------------------------------------------------------
     def eval_train(self, feval=None) -> List[Tuple]:
         score = self._model.train_score()
-        return self._eval_set("training", score, self._train_metrics,
+        return self._eval_set(getattr(self, "_train_data_name", "training"),
+                              score, self._train_metrics,
                               self.train_set, feval)
 
     def eval_valid(self, feval=None) -> List[Tuple]:
@@ -476,6 +479,135 @@ class Booster:
                 for f, v in enumerate(self.feature_importance("gain")) if v > 0},
             "tree_info": trees,
         }
+
+    # -- python-package convenience surface (basic.py parity) ----------
+    def attr(self, key: str):
+        """In-memory model attribute (basic.py Booster.attr)."""
+        return getattr(self, "_attrs", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set/unset (value None) model attributes (basic.py set_attr)."""
+        attrs = getattr(self, "_attrs", None)
+        if attrs is None:
+            attrs = self._attrs = {}
+        for k, v in kwargs.items():
+            if v is None:
+                attrs.pop(k, None)
+            else:
+                attrs[k] = str(v)
+        return self
+
+    def feature_name(self) -> List[str]:
+        return list(self.feature_names)
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """LGBM_BoosterShuffleModels analog (basic.py shuffle_models)."""
+        self._shuffle_models(start_iteration, end_iteration)
+        return self
+
+    def lower_bound(self) -> float:
+        """Sum of per-tree minimum leaf values (GetLowerBoundValue)."""
+        return float(sum(float(np.min(t.leaf_value[:max(t.num_leaves, 1)]))
+                         for t in self.trees))
+
+    def upper_bound(self) -> float:
+        """Sum of per-tree maximum leaf values (GetUpperBoundValue)."""
+        return float(sum(float(np.max(t.leaf_value[:max(t.num_leaves, 1)]))
+                         for t in self.trees))
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        return float(self.trees[tree_id].leaf_value[leaf_id])
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def get_split_value_histogram(self, feature, bins=None):
+        """Histogram of a feature's split thresholds across the model
+        (basic.py get_split_value_histogram)."""
+        if isinstance(feature, str):
+            feature = self.feature_names.index(feature)
+        vals = [float(t.threshold[n]) for t in self.trees
+                for n in range(t.num_nodes())
+                if int(t.split_feature[n]) == int(feature)]
+        vals = np.asarray(vals, np.float64)
+        if bins is None:
+            bins = max(min(len(vals), 32), 1)
+        return np.histogram(vals, bins=bins)
+
+    def trees_to_dataframe(self):
+        """One row per node/leaf across the model
+        (basic.py trees_to_dataframe); requires pandas."""
+        import pandas as pd
+        rows = []
+        for ti, t in enumerate(self.trees):
+            parents = {}
+            for n in range(t.num_nodes()):
+                for c in (t.left_child[n], t.right_child[n]):
+                    parents[int(c)] = f"{ti}-S{n}"
+            # 1-based depth by walk from the root (basic.py column)
+            depth = {0: 1} if t.num_nodes() else {}
+            stack = [0] if t.num_nodes() else [~0]
+            if not t.num_nodes():
+                depth[~0] = 1
+            while stack:
+                n = stack.pop()
+                if n < 0:
+                    continue
+                for c in (int(t.left_child[n]), int(t.right_child[n])):
+                    depth[c] = depth[n] + 1
+                    if c >= 0:
+                        stack.append(c)
+            for n in range(t.num_nodes()):
+                rows.append({
+                    "tree_index": ti,
+                    "node_depth": depth.get(n),
+                    "node_index": f"{ti}-S{n}",
+                    "left_child": f"{ti}-S{t.left_child[n]}"
+                    if t.left_child[n] >= 0 else f"{ti}-L{~t.left_child[n]}",
+                    "right_child": f"{ti}-S{t.right_child[n]}"
+                    if t.right_child[n] >= 0 else f"{ti}-L{~t.right_child[n]}",
+                    "parent_index": parents.get(n),
+                    "split_feature": (self.feature_names[
+                        int(t.split_feature[n])]
+                        if self.feature_names else int(t.split_feature[n])),
+                    "split_gain": float(t.split_gain[n]),
+                    "threshold": float(t.threshold[n]),
+                    "value": float(t.internal_value[n]),
+                    "weight": float(t.internal_weight[n]),
+                    "count": int(t.internal_count[n]),
+                })
+            for leaf in range(t.num_leaves):
+                rows.append({
+                    "tree_index": ti,
+                    "node_depth": depth.get(~leaf, 1),
+                    "node_index": f"{ti}-L{leaf}",
+                    "left_child": None, "right_child": None,
+                    "parent_index": parents.get(~leaf),
+                    "split_feature": None, "split_gain": None,
+                    "threshold": None,
+                    "value": float(t.leaf_value[leaf]),
+                    "weight": float(t.leaf_weight[leaf]),
+                    "count": int(t.leaf_count[leaf]),
+                })
+        return pd.DataFrame(rows)
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List[Tuple]:
+        """Evaluate on an arbitrary dataset (basic.py Booster.eval)."""
+        # grab the raw values BEFORE construct() (which may free them
+        # under free_raw_data=True); predict() accepts dense or sparse
+        raw = data.raw_data if data.raw_data is not None else data._raw_input
+        data.construct(self.config)
+        if raw is None:
+            raw = data.raw_data
+        if raw is None:
+            raise ValueError("eval needs the dataset's raw values "
+                             "(free_raw_data=False)")
+        score = np.asarray(self.predict(raw, raw_score=True))
+        score = score.reshape(data.num_data, -1)
+        metrics = self._make_metrics(data.metadata, data.num_data)
+        return self._eval_set(name, score, metrics, data, feval)
 
     def refit(self, data, label, decay_rate: float = 0.9, **kw) -> "Booster":
         """Refit existing tree structures on new data

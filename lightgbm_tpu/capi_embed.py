@@ -760,29 +760,8 @@ def dataset_get_subset(ds, idx_mv, num: int, params: str):
 
 def dataset_add_features_from(target, source) -> None:
     """LGBM_DatasetAddFeaturesFrom (c_api.h:452): append source's
-    feature columns to target (Dataset::AddFeaturesFrom)."""
-    t, s = _as_dataset(target), _as_dataset(source)
-    t.construct()
-    s.construct()
-    if t.num_data != s.num_data:
-        raise ValueError(
-            f"row mismatch: {t.num_data} vs {s.num_data}")
-    nt = t.num_total_features
-    t.binned = np.concatenate([t.feature_binned(), s.feature_binned()],
-                              axis=1)
-    t.bin_offsets = None
-    t.efb = None                       # bundles no longer match columns
-    t.bin_mappers = list(t.bin_mappers) + list(s.bin_mappers)
-    t.used_features = list(t.used_features) + [
-        nt + f for f in s.used_features]
-    t.num_total_features = nt + s.num_total_features
-    t.feature_names = list(t.feature_names) + list(s.feature_names)
-    if t.raw_data is not None and s.raw_data is not None \
-            and hasattr(t.raw_data, "shape") and hasattr(s.raw_data, "shape"):
-        t.raw_data = np.concatenate(
-            [np.asarray(t.raw_data), np.asarray(s.raw_data)], axis=1)
-    else:
-        t.raw_data = None
+    feature columns to target (Dataset.add_features_from)."""
+    _as_dataset(target).add_features_from(_as_dataset(source))
 
 
 def dataset_dump_text(ds, filename: str) -> None:

@@ -529,6 +529,114 @@ class Dataset:
         self.construct()
         return self.metadata.label
 
+    def get_init_score(self):
+        self.construct()
+        return self.metadata.init_score
+
+    def get_data(self):
+        """Raw feature values (basic.py get_data; needs
+        free_raw_data=False after construction)."""
+        if self.raw_data is not None:
+            return self.raw_data
+        return self._raw_input
+
+    def get_field(self, field_name: str):
+        """Generic metadata accessor (basic.py get_field)."""
+        self.construct()
+        md = self.metadata
+        if field_name == "label":
+            return md.label
+        if field_name == "weight":
+            return md.weight
+        if field_name in ("group", "query"):
+            return md.query_boundaries
+        if field_name == "init_score":
+            return md.init_score
+        raise ValueError(f"unknown field {field_name!r}")
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """Generic metadata setter (basic.py set_field)."""
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name in ("group", "query"):
+            return self.set_group(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        raise ValueError(f"unknown field {field_name!r}")
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Align binning with another dataset (basic.py set_reference);
+        only valid before construction."""
+        if self._constructed:
+            raise ValueError(
+                "cannot set reference after the dataset is constructed")
+        self.reference = reference
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._constructed:
+            raise ValueError("cannot change categorical_feature after "
+                             "construction")
+        self._categorical_in = categorical_feature
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        self._feature_name_in = list(feature_name)
+        if getattr(self, "feature_names", None):
+            if len(self._feature_name_in) != len(self.feature_names):
+                raise ValueError(
+                    f"{len(self._feature_name_in)} names for "
+                    f"{len(self.feature_names)} features")
+            self.feature_names = list(self._feature_name_in)
+        return self
+
+    def feature_num_bin(self, feature: int) -> int:
+        """Bin count of one feature (basic.py feature_num_bin)."""
+        self.construct()
+        return int(self.bin_mappers[int(feature)].num_bin)
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """The reference chain (basic.py get_ref_chain)."""
+        chain, seen = [], set()
+        node = self
+        while node is not None and id(node) not in seen \
+                and len(chain) < ref_limit:
+            chain.append(node)
+            seen.add(id(node))
+            node = node.reference
+        return chain
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append other's feature columns (Dataset::AddFeaturesFrom,
+        LGBM_DatasetAddFeaturesFrom)."""
+        self.construct()
+        other.construct()
+        if self.num_data != other.num_data:
+            raise ValueError(
+                f"row mismatch: {self.num_data} vs {other.num_data}")
+        nt = self.num_total_features
+        self.binned = np.concatenate(
+            [self.feature_binned(), other.feature_binned()], axis=1)
+        self.bin_offsets = None
+        self.efb = None                # bundles no longer match columns
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.used_features = list(self.used_features) + [
+            nt + f for f in other.used_features]
+        self.num_total_features = nt + other.num_total_features
+        self.feature_names = (list(self.feature_names)
+                              + list(other.feature_names))
+        if self.raw_data is not None and other.raw_data is not None \
+                and hasattr(self.raw_data, "shape") \
+                and hasattr(other.raw_data, "shape"):
+            self.raw_data = np.concatenate(
+                [np.asarray(self.raw_data), np.asarray(other.raw_data)],
+                axis=1)
+        else:
+            self.raw_data = None
+        return self
+
     def get_weight(self):
         self.construct()
         return self.metadata.weight

@@ -1,0 +1,158 @@
+"""python-package convenience surface parity (basic.py):
+Booster.attr/set_attr, feature_name, shuffle_models, bounds,
+get_leaf_output, get_split_value_histogram, trees_to_dataframe, eval;
+Dataset get/set_field, get_data, set_reference, set_feature_name,
+feature_num_bin, get_ref_chain, add_features_from."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=1000, f=6, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, f)
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+P = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+     "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def bst():
+    x, y = _data()
+    return lgb.train(dict(P), lgb.Dataset(x, label=y), num_boost_round=6)
+
+
+def test_attr_roundtrip(bst):
+    assert bst.attr("k") is None
+    bst.set_attr(k="v", n=3)
+    assert bst.attr("k") == "v" and bst.attr("n") == "3"
+    bst.set_attr(k=None)
+    assert bst.attr("k") is None
+
+
+def test_feature_name_and_bounds(bst):
+    assert len(bst.feature_name()) == 6
+    assert bst.upper_bound() > bst.lower_bound()
+    assert bst.get_leaf_output(0, 0) == float(bst.trees[0].leaf_value[0])
+
+
+def test_shuffle_models_preserves_predictions_modulo_order(bst):
+    import copy
+    x, _ = _data()
+    b = copy.deepcopy(bst)
+    before = b.predict(x[:50], raw_score=True)
+    b.shuffle_models()
+    # additive model: prediction is order-invariant; tree multiset same
+    np.testing.assert_allclose(b.predict(x[:50], raw_score=True), before,
+                               rtol=1e-9)
+    assert len(b.trees) == len(bst.trees)
+
+
+def test_split_value_histogram(bst):
+    counts, edges = bst.get_split_value_histogram(0)
+    assert counts.sum() == sum(
+        (t.split_feature[:t.num_nodes()] == 0).sum() for t in bst.trees)
+
+
+def test_trees_to_dataframe(bst):
+    pd = pytest.importorskip("pandas")
+    df = bst.trees_to_dataframe()
+    n_nodes = sum(t.num_nodes() for t in bst.trees)
+    n_leaves = sum(t.num_leaves for t in bst.trees)
+    assert len(df) == n_nodes + n_leaves
+    assert set(df["tree_index"]) == set(range(len(bst.trees)))
+    # root rows carry the full data count
+    roots = df[df["node_index"] == "0-S0"]
+    assert int(roots["count"].iloc[0]) == 1000
+
+
+def test_booster_eval_arbitrary_dataset(bst):
+    x, y = _data(seed=1)
+    res = bst.eval(lgb.Dataset(x, label=y, free_raw_data=False), "holdout")
+    assert res and res[0][0] == "holdout"
+    names = {r[1] for r in res}
+    assert "binary_logloss" in names
+    ll = next(r[2] for r in res if r[1] == "binary_logloss")
+    assert 0.0 < ll < 0.6
+
+
+class TestDatasetSurface:
+    def test_fields_and_data(self):
+        x, y = _data(300, 4, seed=2)
+        w = np.abs(np.random.RandomState(3).randn(300)).astype(np.float32)
+        ds = lgb.Dataset(x, label=y, free_raw_data=False)
+        ds.set_field("weight", w)
+        ds.construct()
+        np.testing.assert_allclose(ds.get_field("weight"), w, rtol=1e-6)
+        np.testing.assert_allclose(ds.get_field("label"), y, rtol=1e-6)
+        assert ds.get_data().shape == (300, 4)
+        assert ds.get_init_score() is None
+
+    def test_reference_chain_and_set_reference(self):
+        x, y = _data(300, 4, seed=4)
+        train = lgb.Dataset(x, label=y)
+        valid = lgb.Dataset(x[:100], label=y[:100])
+        valid.set_reference(train)
+        train.construct()
+        valid.construct()
+        chain = valid.get_ref_chain()
+        assert chain[0] is valid and chain[1] is train
+        # aligned binning
+        assert valid.feature_num_bin(0) == train.feature_num_bin(0)
+        with pytest.raises(ValueError):
+            valid.set_reference(train)   # post-construction
+
+    def test_set_feature_name(self):
+        x, y = _data(200, 3, seed=5)
+        ds = lgb.Dataset(x, label=y)
+        ds.set_feature_name(["a", "b", "c"])
+        ds.construct()
+        assert ds.feature_names == ["a", "b", "c"]
+
+    def test_add_features_from_trains(self):
+        x, y = _data(400, 3, seed=6)
+        x2 = np.random.RandomState(7).randn(400, 2)
+        a = lgb.Dataset(x, label=y, free_raw_data=False)
+        b = lgb.Dataset(x2, free_raw_data=False)
+        a.construct()
+        b.construct()
+        a.add_features_from(b)
+        assert a.num_total_features == 5
+        bst = lgb.train(dict(P), a, num_boost_round=4)
+        assert bst.num_feature() == 5
+        assert np.isfinite(bst.predict(np.hstack([x, x2])[:20])).all()
+
+
+def test_trees_to_dataframe_depth(bst):
+    pytest.importorskip("pandas")
+    df = bst.trees_to_dataframe()
+    roots = df[df["node_index"].str.endswith("-S0")]
+    assert (roots["node_depth"] == 1).all()
+    assert df["node_depth"].notna().all()
+    # every child is exactly one deeper than its parent
+    by_idx = df.set_index("node_index")
+    for _, r in df[df["parent_index"].notna()].iterrows():
+        assert r["node_depth"] == by_idx.loc[r["parent_index"],
+                                             "node_depth"] + 1
+
+
+def test_eval_sparse_and_freed_raw(bst):
+    from scipy.sparse import csr_matrix
+    x, y = _data(seed=8)
+    res = bst.eval(lgb.Dataset(csr_matrix(x), label=y), "sparse_hold")
+    assert res[0][0] == "sparse_hold"
+    # raw captured before construct() even with free_raw_data default
+    res2 = bst.eval(lgb.Dataset(x, label=y), "dense_hold")
+    assert np.isfinite(res2[0][2])
+
+
+def test_train_data_name():
+    x, y = _data(400, 4, seed=9)
+    b = lgb.train(dict(P), lgb.Dataset(x, label=y), num_boost_round=2)
+    b.set_train_data_name("my_train")
+    assert b.eval_train()[0][0] == "my_train"
