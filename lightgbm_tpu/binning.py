@@ -108,6 +108,29 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     else:
         mean_bin_size = np.inf
 
+    if not is_big.any():
+        # continuous fast path (no value large enough to demand its own
+        # bin — the overwhelmingly common case for real-valued columns):
+        # the sequential accumulate-and-reset closes a bin at the first
+        # index where the count accumulated since the last close reaches
+        # mean_bin_size, i.e. at searchsorted(cumsum, last + mean) —
+        # one binary search per BIN instead of one Python iteration per
+        # DISTINCT VALUE (a 2000-feature Epsilon-shaped construct spent
+        # ~50 s in this loop; this form is milliseconds).  Output is
+        # identical to the loop below.
+        cum = np.cumsum(counts)
+        last = 0.0
+        for _ in range(max_bin - 1):
+            j = int(np.searchsorted(cum, last + mean_bin_size,
+                                    side="left"))
+            if j >= num_distinct - 1:
+                break
+            bounds.append((float(distinct_values[j])
+                           + float(distinct_values[j + 1])) / 2.0)
+            last = float(cum[j])
+        bounds.append(np.inf)
+        return bounds
+
     cur_cnt = 0
     bin_cnt = 0
     for i in range(num_distinct):

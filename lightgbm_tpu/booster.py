@@ -597,13 +597,8 @@ class Booster:
         """Evaluate on an arbitrary dataset (basic.py Booster.eval)."""
         # grab the raw values BEFORE construct() (which may free them
         # under free_raw_data=True); predict() accepts dense or sparse
-        raw = data.raw_data if data.raw_data is not None else data._raw_input
+        raw = data.get_data()
         data.construct(self.config)
-        if raw is None:
-            raw = data.raw_data
-        if raw is None:
-            raise ValueError("eval needs the dataset's raw values "
-                             "(free_raw_data=False)")
         score = np.asarray(self.predict(raw, raw_score=True))
         score = score.reshape(data.num_data, -1)
         metrics = self._make_metrics(data.metadata, data.num_data)

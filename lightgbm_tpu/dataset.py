@@ -534,11 +534,16 @@ class Dataset:
         return self.metadata.init_score
 
     def get_data(self):
-        """Raw feature values (basic.py get_data; needs
-        free_raw_data=False after construction)."""
+        """Raw feature values (basic.py get_data).  Raises once the raw
+        values were freed (free_raw_data=True after construction), like
+        the reference, instead of silently returning None."""
         if self.raw_data is not None:
             return self.raw_data
-        return self._raw_input
+        if self._raw_input is not None:
+            return self._raw_input
+        raise ValueError(
+            "raw data was freed: construct the Dataset with "
+            "free_raw_data=False to keep it available")
 
     def get_field(self, field_name: str):
         """Generic metadata accessor (basic.py get_field)."""
@@ -583,13 +588,23 @@ class Dataset:
         return self
 
     def set_feature_name(self, feature_name) -> "Dataset":
-        self._feature_name_in = list(feature_name)
+        names = list(feature_name)
+        # validate against whatever width is known NOW — post-construct
+        # the resolved names, pre-construct the raw input's column count
+        # (a silently accepted wrong-sized list would only surface much
+        # later as an IndexError inside plotting/dataframe helpers)
+        nf = len(self.feature_names) if getattr(self, "feature_names",
+                                                None) else 0
+        if not nf:
+            raw = getattr(self, "_raw_input", None)
+            if raw is not None and hasattr(raw, "shape") \
+                    and len(raw.shape) == 2:
+                nf = raw.shape[1]
+        if nf and len(names) != nf:
+            raise ValueError(f"{len(names)} names for {nf} features")
+        self._feature_name_in = names
         if getattr(self, "feature_names", None):
-            if len(self._feature_name_in) != len(self.feature_names):
-                raise ValueError(
-                    f"{len(self._feature_name_in)} names for "
-                    f"{len(self.feature_names)} features")
-            self.feature_names = list(self._feature_name_in)
+            self.feature_names = list(names)
         return self
 
     def feature_num_bin(self, feature: int) -> int:

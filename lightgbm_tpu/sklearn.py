@@ -135,16 +135,35 @@ class LGBMModel:
                 valid_names.append(
                     eval_names[i] if eval_names else f"valid_{i}")
 
+        from .callback import record_evaluation
+        evals: Dict = {}
+        cbs = list(callbacks or [])
+        if valid_sets:
+            cbs.append(record_evaluation(evals))
         self._Booster = train_fn(params, ds,
                                  num_boost_round=self.n_estimators,
                                  valid_sets=valid_sets or None,
                                  valid_names=valid_names or None,
-                                 feval=feval, callbacks=callbacks)
+                                 feval=feval, callbacks=cbs or None)
         self._n_features = np.asarray(X).shape[1] if hasattr(X, "shape") else \
             len(X[0])
         self.best_iteration_ = self._Booster.best_iteration
         self.best_score_ = self._Booster.best_score
+        # sklearn-API result attributes (reference sklearn.py fit tail)
+        self._evals_result = evals
+        self.fitted_ = True
+        self.n_iter_ = (self.best_iteration_
+                        if self.best_iteration_ and self.best_iteration_ > 0
+                        else self._Booster.current_iteration)
+        self.objective_ = params.get("objective",
+                                     getattr(self, "objective", None))
         return self
+
+    @property
+    def evals_result_(self) -> Dict:
+        """Per-eval-set metric history recorded during fit
+        (reference sklearn.py evals_result_)."""
+        return getattr(self, "_evals_result", {})
 
     def _process_label(self, y: np.ndarray) -> np.ndarray:
         return y.astype(np.float32)

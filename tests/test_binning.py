@@ -143,3 +143,46 @@ class TestDataset:
         ds = Dataset(df, label=rs.rand(300)).construct()
         assert ds.feature_names == ["a", "b"]
         assert ds.bin_mappers[1].bin_type == BinType.CATEGORICAL
+
+
+def test_greedy_fast_path_matches_loop():
+    """The no-big-values fast path in _greedy_find_bin (one binary
+    search per bin) must reproduce the sequential accumulate-and-reset
+    loop exactly, for unit and mixed counts."""
+    from lightgbm_tpu.binning import _greedy_find_bin
+
+    def loop_ref(dv, counts, max_bin, total, mdb):
+        bounds = []
+        if mdb > 0:
+            max_bin = max(1, min(max_bin, total // mdb))
+        m = total / max_bin
+        is_big = counts >= m
+        rest = total - int(counts[is_big].sum())
+        rb = max_bin - int(is_big.sum())
+        m = rest / rb if rb > 0 else np.inf
+        cur = 0
+        bc = 0
+        n = len(dv)
+        for i in range(n):
+            cur += int(counts[i])
+            close = bool(is_big[i]) or cur >= m \
+                or (i + 1 < n and bool(is_big[i + 1]))
+            if close and i + 1 < n:
+                bounds.append((float(dv[i]) + float(dv[i + 1])) / 2.0)
+                cur = 0
+                bc += 1
+                if bc >= max_bin - 1:
+                    break
+        bounds.append(np.inf)
+        return bounds
+
+    rng = np.random.RandomState(7)
+    for trial in range(60):
+        dv = np.unique(rng.randn(rng.randint(80, 2000))
+                       .astype(np.float32).astype(np.float64))
+        counts = rng.randint(1, 4, size=len(dv)).astype(np.float64)
+        mb = rng.randint(3, min(len(dv) - 1, 200))
+        mdb = int(rng.choice([0, 1, 3, 10]))
+        total = int(counts.sum())
+        assert _greedy_find_bin(dv, counts, mb, total, mdb) \
+            == loop_ref(dv, counts, mb, total, mdb), (trial, mb, mdb)

@@ -156,3 +156,25 @@ def test_train_data_name():
     b = lgb.train(dict(P), lgb.Dataset(x, label=y), num_boost_round=2)
     b.set_train_data_name("my_train")
     assert b.eval_train()[0][0] == "my_train"
+
+
+def test_get_data_raises_after_free():
+    x, y = _data(200, 3, seed=10)
+    ds = lgb.Dataset(x, label=y, free_raw_data=True)
+    lgb.train(dict(P), ds, num_boost_round=1)
+    with pytest.raises(ValueError, match="free_raw_data"):
+        ds.get_data()
+
+
+def test_set_feature_name_wrong_size_fails_early():
+    x, y = _data(200, 3, seed=11)
+    ds = lgb.Dataset(x, label=y)
+    with pytest.raises(ValueError, match="2 names for 3 features"):
+        ds.set_feature_name(["a", "b"])
+
+
+def test_eval_on_loaded_model(bst):
+    x, y = _data(seed=12)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    res = loaded.eval(lgb.Dataset(x, label=y, free_raw_data=False), "h")
+    assert res and np.isfinite(res[0][2])

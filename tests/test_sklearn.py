@@ -104,3 +104,18 @@ class TestRanker:
     def test_requires_group(self):
         with pytest.raises(ValueError):
             LGBMRanker().fit(np.zeros((10, 2)), np.zeros(10))
+
+
+def test_sklearn_result_attributes():
+    import numpy as np
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    rs = np.random.RandomState(0)
+    x = rs.randn(800, 5)
+    y = (x[:, 0] > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=6, num_leaves=7, verbosity=-1)
+    clf.fit(x, y, eval_set=[(x[:200], y[:200])])
+    assert clf.fitted_ is True
+    assert clf.n_iter_ == 6
+    assert clf.objective_ == "binary"
+    er = clf.evals_result_
+    assert "valid_0" in er and any(len(v) == 6 for v in er["valid_0"].values())
