@@ -167,6 +167,11 @@ class BinMapper:
         self.default_bin: int = 0      # bin of value 0.0 (most common for sparse)
         self.most_freq_bin: int = 0
         self.sparse_rate: float = 0.0
+        # exact fraction of the fit sample that lands in bin 0 (incl.
+        # NaNs when they map there); 1.0 = "unknown" — the conservative
+        # value for the EFB pigeonhole pre-check (dataset.py), which
+        # needs a LOWER bound on the non-default rate
+        self.bin0_frac: float = 1.0
 
     # -- fit ---------------------------------------------------------------
     def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
@@ -237,6 +242,16 @@ class BinMapper:
             mf_val = distinct[int(np.argmax(counts))]
             self.most_freq_bin = int(np.searchsorted(ub, mf_val, side="left"))
             self.sparse_rate = float(counts.max() / max(total_non_na, 1))
+        # exact bin-0 occupancy of the sample: cumulative count of the
+        # distinct values at/below the first upper bound (bin 0 may merge
+        # SEVERAL distinct values — sparse_rate, the single most frequent
+        # VALUE's share, underestimates it), plus NaN rows when the
+        # missing policy routes them to the zero bin
+        if len(counts) > 0 and len(ub) > 0:
+            in_bin0 = int(counts[distinct <= ub[0]].sum())
+            if self.missing_type == MissingType.ZERO:
+                in_bin0 += na_cnt
+            self.bin0_frac = in_bin0 / max(total_non_na + na_cnt, 1)
         if self.missing_type == MissingType.ZERO and zero_cnt + na_cnt == 0:
             self.missing_type = MissingType.NONE
 
@@ -321,6 +336,7 @@ class BinMapper:
             "default_bin": self.default_bin,
             "most_freq_bin": self.most_freq_bin,
             "sparse_rate": self.sparse_rate,
+            "bin0_frac": self.bin0_frac,
         }
 
     @classmethod
@@ -336,4 +352,5 @@ class BinMapper:
         m.default_bin = int(st["default_bin"])
         m.most_freq_bin = int(st["most_freq_bin"])
         m.sparse_rate = float(st["sparse_rate"])
+        m.bin0_frac = float(st.get("bin0_frac", 1.0))
         return m

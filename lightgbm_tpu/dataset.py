@@ -472,6 +472,23 @@ class Dataset:
             # EFB over the fitting sample (FastFeatureBundling,
             # dataset.cpp:239; see efb.py)
             mappers = [self.bin_mappers[f] for f in self.used_features]
+            # pigeonhole pre-check: a pair can bundle only if
+            # nz_i + nz_j - S <= budget (their non-default rows can't
+            # all avoid each other otherwise).  If even the two
+            # sparsest features fail that bound, no bundle is possible
+            # and the whole conflict-sampling pass — a second
+            # value_to_bin over every feature, the dominant cost on
+            # wide DENSE data like Epsilon — is provably a no-op.
+            # nz comes from the mapper's EXACT bin-0 occupancy
+            # (bin0_frac; NOT 1-sparse_rate, which is the single most
+            # frequent VALUE's share and under-counts a bin 0 that
+            # merged several values — that would disable real bundles).
+            # Unknown occupancy (loaded mappers) is 1.0 -> nz 0 -> the
+            # gate never fires and the full conflict count runs.
+            nz_frac = np.sort([1.0 - m.bin0_frac for m in mappers])
+            if nz_frac[0] + nz_frac[1] - 1.0 > cfg.max_conflict_rate:
+                self.efb = None
+                return
             sample_bins = np.column_stack(
                 [m.value_to_bin(sample_col(f)) for m, f
                  in zip(mappers, self.used_features)])

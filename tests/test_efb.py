@@ -141,3 +141,47 @@ class TestEFBMaskedLearner:
         assert bm._model._use_efb and bp._model._use_efb
         np.testing.assert_allclose(bm.predict(x), bp.predict(x),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_pigeonhole_skip_uses_bin0_occupancy_not_value_share():
+    """The dense-data EFB skip (dataset.py pigeonhole pre-check) must
+    bound the non-default rate with the EXACT bin-0 occupancy
+    (BinMapper.bin0_frac).  1 - sparse_rate (the most frequent VALUE's
+    share) under-counts a zero bin that merged several distinct values
+    and would silently disable real bundles (code-review r4)."""
+    rng = np.random.RandomState(5)
+    n = 6000
+    a = np.zeros(n)
+    b = np.zeros(n)
+    half = n // 2
+    a[:half] = rng.rand(half) + 0.5
+    # extra near-zero distinct values so bin 0 merges several values and
+    # the most-frequent-value share understates its occupancy
+    a[half:half + 600] = rng.choice([1e-35, 0.0], 600)
+    b[half:] = rng.rand(half) + 0.5
+    x = np.column_stack([a, b, rng.randn(n)])
+    y = (a + b > 1.0).astype(np.float32)
+    ds = lgb.Dataset(x, label=y, params={"max_bin": 15, "verbosity": -1})
+    ds.construct()
+    assert ds.efb is not None
+    assert any(len(g) == 2 for g in ds.efb.groups), \
+        f"mutually exclusive pair must bundle: {ds.efb.groups}"
+
+
+def test_pigeonhole_skip_fires_on_dense(monkeypatch):
+    """Dense wide data provably cannot bundle: the pre-check must skip
+    the whole conflict-sampling pass (no second value_to_bin sweep)."""
+    import lightgbm_tpu.efb as efb_mod
+    called = []
+    orig = efb_mod.find_bundles
+    monkeypatch.setattr(efb_mod, "find_bundles",
+                        lambda *a, **k: called.append(1) or orig(*a, **k))
+    import lightgbm_tpu.dataset as ds_mod
+    monkeypatch.setattr(ds_mod, "find_bundles", efb_mod.find_bundles)
+    rng = np.random.RandomState(6)
+    x = rng.standard_normal((3000, 20))
+    ds = lgb.Dataset(x, label=(x[:, 0] > 0).astype(np.float32),
+                     params={"max_bin": 31, "verbosity": -1})
+    ds.construct()
+    assert ds.efb is None
+    assert not called, "conflict sampling ran despite the pigeonhole skip"
