@@ -506,15 +506,30 @@ class Booster:
         self._shuffle_models(start_iteration, end_iteration)
         return self
 
+    def _bounds(self):
+        """(lower, upper) summed per tree.  The reference folds shrinkage
+        into leaf values so GetLowerBoundValue sums raw leaf extrema; this
+        framework applies tree_weights at predict time (DART/RF), so the
+        extrema must be scaled by the same weights here."""
+        weights = list(self.tree_weights) if self.tree_weights else []
+        lo = hi = 0.0
+        for ti, t in enumerate(self.trees):
+            w = float(weights[ti]) if ti < len(weights) else 1.0
+            mn = float(np.min(t.leaf_value[:max(t.num_leaves, 1)])) * w
+            mx = float(np.max(t.leaf_value[:max(t.num_leaves, 1)])) * w
+            lo += min(mn, mx)
+            hi += max(mn, mx)
+        return lo, hi
+
     def lower_bound(self) -> float:
-        """Sum of per-tree minimum leaf values (GetLowerBoundValue)."""
-        return float(sum(float(np.min(t.leaf_value[:max(t.num_leaves, 1)]))
-                         for t in self.trees))
+        """Weighted sum of per-tree minimum leaf values
+        (GetLowerBoundValue)."""
+        return self._bounds()[0]
 
     def upper_bound(self) -> float:
-        """Sum of per-tree maximum leaf values (GetUpperBoundValue)."""
-        return float(sum(float(np.max(t.leaf_value[:max(t.num_leaves, 1)]))
-                         for t in self.trees))
+        """Weighted sum of per-tree maximum leaf values
+        (GetUpperBoundValue)."""
+        return self._bounds()[1]
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
         return float(self.trees[tree_id].leaf_value[leaf_id])
@@ -651,33 +666,46 @@ class Booster:
         return self
 
     def _merge_from(self, other: "Booster") -> None:
-        """LGBM_BoosterMerge (c_api.h:522): append other's trees."""
+        """LGBM_BoosterMerge (c_api.h:522): insert other's trees at the
+        FRONT of this booster, self's after — GBDT::MergeFrom
+        (gbdt.h:63-80) pushes the other booster's models first, so
+        order-sensitive consumers (pred_leaf columns, iteration slicing,
+        tree indices, saved tree order) must see other-first here too."""
         if other._num_tree_per_iteration != self._num_tree_per_iteration:
             raise ValueError("cannot merge boosters with different "
                              "num_tree_per_iteration")
         import copy as _copy
         new_trees = [_copy.deepcopy(t) for t in other.trees]
+        new_weights = (list(other.tree_weights) if other.tree_weights
+                       else [1.0] * len(new_trees))
         if self._model is not None:
-            self._model.models.extend(new_trees)
-            self._model.tree_weights.extend(
-                list(other.tree_weights) if other.tree_weights
-                else [1.0] * len(new_trees))
-            if hasattr(other, "_model") and other._model is not None \
-                    and len(other._model.device_trees) == len(new_trees):
-                self._model.device_trees.extend(other._model.device_trees)
-            self._model.iter_ += len(new_trees) \
-                // self._num_tree_per_iteration
+            m = self._model
+            m.models[:0] = new_trees
+            m.tree_weights[:0] = new_weights
+            # device_trees must stay aligned to the TAIL of models
+            # (models/gbdt.py add_valid_set: the first
+            # len(models)-len(device_trees) trees replay host-side).
+            # Inserting at the front keeps self's device tail intact;
+            # other's device copies can only be prepended when BOTH
+            # sides have full device coverage (otherwise a gap would
+            # break the tail invariant).
+            other_dev = (other._model.device_trees
+                         if getattr(other, "_model", None) is not None
+                         else [])
+            if (len(other_dev) == len(new_trees)
+                    and len(m.device_trees)
+                    == len(m.models) - len(new_trees)):
+                m.device_trees[:0] = other_dev
+            m.iter_ += len(new_trees) // self._num_tree_per_iteration
             self._sync_trees()
         else:
-            self.trees.extend(new_trees)
-            self.tree_weights.extend(
-                list(other.tree_weights) if other.tree_weights
-                else [1.0] * len(new_trees))
+            self.trees[:0] = new_trees
+            self.tree_weights[:0] = new_weights
 
     def _shuffle_models(self, start_iter: int, end_iter: int) -> None:
         """LGBM_BoosterShuffleModels (c_api.h:512; GBDT::ShuffleModels):
         permute whole iterations in [start_iter, end_iter) (<=0 end =
-        all) with the data_random_seed stream."""
+        all) with the reference's fixed Random(17) swap sequence."""
         k = self._num_tree_per_iteration
         trees = self.trees
         n_iter = len(trees) // k
@@ -685,10 +713,23 @@ class Booster:
         start_iter = max(0, start_iter)
         if end_iter - start_iter < 2:
             return
-        rng = np.random.RandomState(self.config.data_random_seed
-                                    if hasattr(self, "config") else 1)
-        perm = np.arange(start_iter, end_iter)
-        rng.shuffle(perm)
+        # reference-exact permutation: GBDT::ShuffleModels (gbdt.h:82-105)
+        # runs a partial Fisher-Yates with its own LCG seeded at 17
+        # (Random::NextShort, utils/random.h: x = 214013*x + 2531011,
+        # take bits 16..30) — reproduce the identical swap sequence so
+        # LGBM_BoosterShuffleModels matches the reference ABI bit-for-bit
+        lcg = 17
+
+        def _next_short(lo: int, hi: int) -> int:
+            nonlocal lcg
+            lcg = (214013 * lcg + 2531011) & 0xFFFFFFFF
+            return ((lcg >> 16) & 0x7FFF) % (hi - lo) + lo
+
+        indices = list(range(n_iter))
+        for i in range(start_iter, end_iter - 1):
+            j = _next_short(i + 1, end_iter)
+            indices[i], indices[j] = indices[j], indices[i]
+        perm = [indices[i] for i in range(start_iter, end_iter)]
 
         def _permute(seq):
             """Apply the same iteration-block permutation to any list
@@ -707,6 +748,11 @@ class Booster:
             m.tree_weights[:] = _permute(list(m.tree_weights))
             if len(m.device_trees) == len(trees):
                 m.device_trees[:] = _permute(list(m.device_trees))
+            elif m.device_trees:
+                # partial device coverage cannot stay tail-aligned under
+                # a permutation of all models — drop the device copies
+                # and let consumers (add_valid_set) replay host-side
+                m.device_trees.clear()
             m.models[:] = new_trees
             self._sync_trees()
         else:
@@ -726,7 +772,19 @@ class Booster:
             else list(self.tree_weights)
         old_iter = (self._model.iter_ if self._model is not None
                     else len(old_models) // self._num_tree_per_iteration)
-        self.train_set = train_set.construct(self.config)
+        new_train = train_set.construct(self.config)
+        if old_models and new_train.raw_data is None:
+            # without raw values the existing ensemble cannot be scored
+            # on the new data — continuing would silently train as if
+            # the model predicted zero everywhere (same guard as
+            # add_valid_set for the free_raw_data=True case); checked
+            # BEFORE any state is replaced so a caught error leaves the
+            # booster usable
+            raise ValueError(
+                "reset_training_data on a non-empty booster needs the new "
+                "dataset's raw values to rebuild the training score; "
+                "construct it with free_raw_data=False")
+        self.train_set = new_train
         self._model = create_boosting(self.config, self.train_set,
                                       create_objective(self.config))
         m = self._model

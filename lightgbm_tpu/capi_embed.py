@@ -901,13 +901,11 @@ def booster_refit_leaf_preds(bst: Booster, leaf_mv, nrow: int,
 
 
 def booster_upper_bound(bst: Booster) -> float:
-    return float(sum(float(np.max(t.leaf_value[:max(t.num_leaves, 1)]))
-                     for t in bst.trees))
+    return bst._bounds()[1]
 
 
 def booster_lower_bound(bst: Booster) -> float:
-    return float(sum(float(np.min(t.leaf_value[:max(t.num_leaves, 1)]))
-                     for t in bst.trees))
+    return bst._bounds()[0]
 
 
 def booster_predict_csc2(bst: Booster, colptr_mv, colptr_type, indices_mv,

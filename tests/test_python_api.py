@@ -178,3 +178,91 @@ def test_eval_on_loaded_model(bst):
     loaded = lgb.Booster(model_str=bst.model_to_string())
     res = loaded.eval(lgb.Dataset(x, label=y, free_raw_data=False), "h")
     assert res and np.isfinite(res[0][2])
+
+
+# --- round-5 advisor regressions (ADVICE r4): merge order, shuffle
+# sequence, weighted bounds, reset_training_data guard ----------------
+
+def test_merge_puts_other_trees_first(bst):
+    """GBDT::MergeFrom (gbdt.h:63-80) pushes the OTHER booster's models
+    first; tree indices of order-sensitive consumers must match."""
+    import copy
+    x, y = _data(seed=5)
+    a = copy.deepcopy(bst)
+    b = lgb.train(dict(P), lgb.Dataset(x, label=y), num_boost_round=2)
+    a_first_leaf = float(a.trees[0].leaf_value[0])
+    b_first_leaf = float(b.trees[0].leaf_value[0])
+    a._merge_from(b)
+    assert len(a.trees) == len(bst.trees) + 2
+    # other's trees lead, self's follow
+    assert float(a.trees[0].leaf_value[0]) == b_first_leaf
+    assert float(a.trees[2].leaf_value[0]) == a_first_leaf
+    # merged prediction == sum of the two ensembles
+    pred = a.predict(x[:50], raw_score=True)
+    np.testing.assert_allclose(
+        pred,
+        bst.predict(x[:50], raw_score=True)
+        + b.predict(x[:50], raw_score=True), rtol=1e-6)
+
+
+def test_merge_string_loaded_keeps_device_tail(bst):
+    """Merging a string-loaded booster (no device trees) must keep
+    device_trees aligned to the TAIL of models — add_valid_set
+    (models/gbdt.py) replays the first len(models)-len(device_trees)
+    trees host-side (ADVICE r4 medium #2)."""
+    x, y = _data()
+    a = lgb.train(dict(P), lgb.Dataset(x, label=y), num_boost_round=4)
+    b = lgb.Booster(model_str=a.model_to_string())
+    n_trees = len(a.trees)
+    n_dev_before = len(a._model.device_trees)
+    a._merge_from(b)
+    m = a._model
+    assert len(m.device_trees) == n_dev_before
+    n_host_only = len(m.models) - len(m.device_trees)
+    # the host-only head is exactly the merged-in (string-loaded) trees
+    assert n_host_only == len(b.trees) + (n_trees - n_dev_before)
+    # validation scoring must still see all trees (tail invariant holds)
+    pred = a.predict(x[:20], raw_score=True)
+    np.testing.assert_allclose(
+        pred, 2.0 * b.predict(x[:20], raw_score=True), rtol=1e-6)
+
+
+def test_shuffle_models_reference_sequence(bst):
+    """ShuffleModels uses the reference's fixed Random(17) partial
+    Fisher-Yates (gbdt.h:82-105, utils/random.h LCG), NOT a numpy
+    stream — verify against an independent emulation."""
+    import copy
+    b = copy.deepcopy(bst)
+    n = len(b.trees)
+    orig = [float(t.leaf_value[0]) for t in b.trees]
+    b.shuffle_models()
+    lcg = 17
+    idx = list(range(n))
+    for i in range(0, n - 1):
+        lcg = (214013 * lcg + 2531011) & 0xFFFFFFFF
+        j = ((lcg >> 16) & 0x7FFF) % (n - (i + 1)) + i + 1
+        idx[i], idx[j] = idx[j], idx[i]
+    expect = [orig[idx[i]] for i in range(n)]
+    got = [float(t.leaf_value[0]) for t in b.trees]
+    assert got == expect
+
+
+def test_bounds_scale_by_tree_weights(bst):
+    """lower/upper_bound must scale per-tree extrema by tree_weights
+    (this framework applies DART/RF weights at predict time)."""
+    b = lgb.Booster(model_str=bst.model_to_string())
+    lo0, hi0 = b.lower_bound(), b.upper_bound()
+    b.tree_weights = [0.5] * len(b.trees)
+    assert b.lower_bound() == pytest.approx(0.5 * lo0)
+    assert b.upper_bound() == pytest.approx(0.5 * hi0)
+
+
+def test_reset_training_data_requires_raw(bst):
+    import copy
+    x, y = _data(seed=9)
+    b = copy.deepcopy(bst)
+    ds = lgb.Dataset(x, label=y, params=dict(P), free_raw_data=True)
+    ds.construct()
+    ds.raw_data = None
+    with pytest.raises(ValueError, match="raw values"):
+        b.reset_training_data(ds)
