@@ -44,13 +44,23 @@ METRIC = "higgs1m_binary_train_iters_per_sec"
 N_ROWS, N_FEAT = 1_000_000, 28
 PRIMARY_LEAVES, PRIMARY_MAX_BIN = 31, 63
 PRIMARY_PADDED_BIN = 64          # ops/histogram.py pads the bin axis to 64
-POINTS_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_POINTS.jsonl")
+_DIR = os.path.dirname(os.path.abspath(__file__))
+# per-run points file (superseded each run); children honor the override
+# so the opportunistic capture (tools/tpu_watch.py) can redirect points
+# to the durable capture file below
+POINTS_FILE = os.environ.get("_BENCH_POINTS_FILE") \
+    or os.path.join(_DIR, "BENCH_POINTS.jsonl")
+# durable across runs: TPU points captured mid-round by tools/tpu_watch.py
+# the moment the tunnel grants a claim.  The end-of-round bench PREFERS a
+# point from here over a CPU fallback (VERDICT r4 task 1: one clean TPU
+# measurement beats any number of degraded ones).
+CAPTURE_FILE = os.environ.get("_BENCH_CAPTURE_FILE") \
+    or os.path.join(_DIR, "BENCH_TPU_CAPTURE.jsonl")
 
 PROBE_TIMEOUT = 150              # healthy claims take ~0.1 s (BENCH_r02)
 PRIMARY_TIMEOUT = 600            # hard cap, VERDICT r3 task 1
 QUICK_TIMEOUT = 300
-EXTRAS_TIMEOUT = 600
+EXTRAS_TIMEOUT = 900
 CPU_TIMEOUT = 420
 
 # bf16/f32 MXU peak per chip for MFU estimate; unknown kinds report FLOP/s.
@@ -63,7 +73,7 @@ PEAK_FLOPS = {
 def _record_point(name, **kv):
     """Append one measured point to the results file IMMEDIATELY (crash /
     timeout safe) and mirror it to stderr for the log tail."""
-    rec = {"point": name, **kv}
+    rec = {"point": name, "t": time.strftime("%Y-%m-%dT%H:%M:%S"), **kv}
     try:
         with open(POINTS_FILE, "a") as f:
             f.write(json.dumps(rec) + "\n")
@@ -111,19 +121,26 @@ def make_epsilon_like(n: int, f: int, seed: int = 3):
 
 
 def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
-                 split_batch=0, max_bin=PRIMARY_MAX_BIN):
-    """Train one config; returns (ips, auc, ds) steady-state over n_chunks
-    fused chunks (or per-iter updates when fusion is unavailable).  Pass
-    ``ds`` to reuse an already-binned dataset (num_leaves is a Booster
-    param; binning is identical across points on the same data).
+                 split_batch=0, max_bin=PRIMARY_MAX_BIN, learner=None):
+    """Train one config; returns (ips, auc, ds, steps) steady-state over
+    n_chunks fused chunks (or per-iter updates when fusion is
+    unavailable).  ``steps`` is the per-tree grower loop count
+    (super-steps for split_batch>1) from the last chunk.  Pass ``ds`` to
+    reuse an already-binned dataset (num_leaves is a Booster param;
+    binning is identical across points on the same data).
     split_batch: 0 = config auto (strict below 64 leaves, batched above),
-    explicit K pins the grower's super-step width (grower.py)."""
+    explicit K pins the grower's super-step width (grower.py).
+    learner: pin tpu_learner (CPU fallback auto-selects the partitioned
+    host-driven learner, which never batches splits — pass "masked" to
+    measure the super-step path on CPU)."""
     params = {
         "objective": "binary", "num_leaves": num_leaves,
         "learning_rate": 0.1, "max_bin": max_bin,
         "min_data_in_leaf": 20, "verbosity": 0,
         "split_batch": split_batch,
     }
+    if learner:
+        params["tpu_learner"] = learner
     t0 = time.time()
     if ds is None:
         ds = lgb.Dataset(x, label=y, params=params)
@@ -160,11 +177,12 @@ def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
 
     from lightgbm_tpu.metrics import _auc
     auc = _auc(y, np.asarray(m.train_score())[:, 0], None)
+    steps = m.step_counts[-min(len(m.step_counts), 8):]
     print(f"[bench] {tag}: bin={t_bin:.1f}s compile+warm={t_compile:.1f}s "
           f"steady={dt:.1f}s/{iters} iters -> {ips:.3f} iters/s "
-          f"(train-AUC={auc:.4f}, fused={fused})",
+          f"(train-AUC={auc:.4f}, fused={fused}, steps/tree={steps[-1] if steps else '?'})",
           file=sys.stderr, flush=True)
-    return ips, auc, ds
+    return ips, auc, ds, steps
 
 
 def _claim_device(cpu: bool):
@@ -204,22 +222,26 @@ def child_primary() -> None:
     # primary: 1M x 28, 31 leaves, 8-way batched super-steps (the
     # framework's fast growth mode; AUC reported alongside so quality is
     # auditable against the strict point below)
-    ips1, auc1, ds1 = _train_point(lgb, x, y, num_leaves=PRIMARY_LEAVES,
-                                   chunk=4 if quick else 25,
-                                   n_chunks=1 if quick else 4,
-                                   tag="1M/31leaf/sb8", split_batch=8)
+    ips1, auc1, ds1, steps1 = _train_point(
+        lgb, x, y, num_leaves=PRIMARY_LEAVES,
+        chunk=4 if quick else 25, n_chunks=1 if quick else 4,
+        tag="1M/31leaf/sb8", split_batch=8)
     rec = {
         "metric": METRIC,
         "value": round(ips1, 3),
         "unit": ("iters/s (1M rows x 28 feat, 31 leaves, 63 bins, "
                  "split_batch=8)"),
-        "vs_baseline": round(ips1 / BASELINE_IPS, 3),
+        # vs_baseline is only meaningful at the baseline's own data size;
+        # the reduced CPU-fallback shape nulls it instead of reporting a
+        # misleading ratio (VERDICT r4 weak #5)
+        "vs_baseline": round(ips1 / BASELINE_IPS, 3) if not cpu else None,
     }
     if cpu:
         rec["unit"] += f" [CPU fallback, {n} rows]"
     # persist + emit the primary record NOW: a later timeout kill (or a
     # hang in the strict point) must not discard it
-    _record_point("primary", auc=round(float(auc1), 4), cpu=cpu, **rec)
+    _record_point("primary", auc=round(float(auc1), 4), cpu=cpu,
+                  steps_per_tree=steps1[-1] if steps1 else None, **rec)
     print(json.dumps(rec), flush=True)
 
     # observability: achieved histogram FLOP/s + MFU estimate
@@ -234,11 +256,11 @@ def child_primary() -> None:
         # strict leaf-wise growth (split_batch=1): round-over-round
         # comparable with BENCH_r02/r03 history + the AUC quality anchor
         try:
-            ips0, auc0, _ = _train_point(lgb, x, y,
-                                         num_leaves=PRIMARY_LEAVES,
-                                         chunk=25, n_chunks=2,
-                                         tag="1M/31leaf/strict", ds=ds1,
-                                         split_batch=1)
+            ips0, auc0, _, _ = _train_point(lgb, x, y,
+                                            num_leaves=PRIMARY_LEAVES,
+                                            chunk=25, n_chunks=2,
+                                            tag="1M/31leaf/strict", ds=ds1,
+                                            split_batch=1)
             _record_point("higgs1m_31leaf_strict", value=round(ips0, 3),
                           auc=round(float(auc0), 4))
         except Exception as e:
@@ -249,43 +271,81 @@ def child_primary() -> None:
 def child_extras() -> None:
     """The non-primary points, each persisted as it lands.  Runs in its
     own child AFTER the primary is safe; a wedge/timeout here costs only
-    the points not yet reached."""
-    devs = _claim_device(cpu=os.environ.get("_BENCH_CPU") == "1")
+    the points not yet reached.  On the CPU fallback the shapes shrink
+    10x and vs_baseline is omitted (shape mismatch), but the points
+    still run (VERDICT r4 weak #1: round 4's structural changes had no
+    empirical record anywhere) — with tpu_learner=masked pinned, since
+    CPU auto-selects the partitioned learner which never batches."""
+    cpu = os.environ.get("_BENCH_CPU") == "1"
+    devs = _claim_device(cpu=cpu)
     import lightgbm_tpu as lgb
 
-    x, y = make_higgs_like(N_ROWS, N_FEAT)
+    n = N_ROWS if not cpu else N_ROWS // 10
+    learner = "masked" if cpu else None
+    x, y = make_higgs_like(n, N_FEAT)
 
     # the baseline's own 255-leaf tree shape (VERDICT r2 task 3a; the
     # vs_baseline that matters most — 3.843 iters/s IS this shape).
     # auto split_batch=16 -> M=3K=48 of the MXU's 128 rows; the achieved
     # histogram FLOP/s double as the MFU evidence for VERDICT r3 task 3.
+    # steps_per_tree is the while-loop super-step count: ~16-20 for a
+    # balanced 255-leaf tree at K=16 (vs 254 for the old static loop).
+    ds2 = ips2 = None
     try:
-        ips2, auc2, _ = _train_point(lgb, x, y, num_leaves=255, chunk=4,
-                                     n_chunks=2, tag="1M/255leaf")
-        flops = _hist_flops_per_iter(N_ROWS, 255) * ips2
+        ips2, auc2, ds2, st2 = _train_point(
+            lgb, x, y, num_leaves=255, chunk=4,
+            n_chunks=2, tag=f"{n//1000}k/255leaf", learner=learner)
+        flops = _hist_flops_per_iter(n, 255) * ips2
         peak = _peak_for(devs)
         _record_point("higgs1m_255leaf", value=round(ips2, 3),
-                      auc=round(float(auc2), 4),
-                      vs_baseline=round(ips2 / BASELINE_IPS, 3),
+                      auc=round(float(auc2), 4), cpu=cpu,
+                      steps_per_tree=st2[-1] if st2 else None,
+                      vs_baseline=(round(ips2 / BASELINE_IPS, 3)
+                                   if not cpu else None),
                       hist_tflops=round(flops / 1e12, 2),
                       mfu=round(flops / peak, 4) if peak else None)
     except Exception as e:
         _record_point("higgs1m_255leaf",
                       error=f"{type(e).__name__}: {e}"[:200])
 
-    # Epsilon-shaped wide point (VERDICT r3 task 6: 400k x 2000 dense)
+    # Epsilon-shaped wide point (VERDICT r3 task 6: 400k x 2000 dense).
+    # Runs BEFORE the slow strict point below so a timeout starves the
+    # least important measurement, not this one.
     try:
-        xe, ye = make_epsilon_like(400_000, 2000)
-        ipse, auce, _ = _train_point(lgb, xe, ye, num_leaves=PRIMARY_LEAVES,
-                                     chunk=4, n_chunks=2,
-                                     tag="400k/2000f/31leaf", split_batch=8)
-        _record_point("epsilon400k_2000f", value=round(ipse, 3),
-                      auc=round(float(auce), 4))
+        ne, fe = (400_000, 2000) if not cpu else (40_000, 500)
+        xe, ye = make_epsilon_like(ne, fe)
+        ipse, auce, _, _ = _train_point(
+            lgb, xe, ye, num_leaves=PRIMARY_LEAVES, chunk=4, n_chunks=2,
+            tag=f"{ne//1000}k/{fe}f/31leaf", split_batch=8,
+            learner=learner)
+        _record_point("epsilon400k_2000f", value=round(ipse, 3), cpu=cpu,
+                      shape=f"{ne}x{fe}", auc=round(float(auce), 4))
         del xe, ye
     except Exception as e:
         _record_point("epsilon400k_2000f",
                       error=f"{type(e).__name__}: {e}"[:200])
 
+    # strict (split_batch=1) 255-leaf on the same data: the measured
+    # K=16-vs-1 super-step ratio — the empirical record for round 4's
+    # two structural claims (while-loop growers + auto split_batch).
+    # ~254 passes/tree makes this the slowest point; it runs last.
+    if ds2 is not None:
+        try:
+            ips2s, _, _, st2s = _train_point(
+                lgb, x, y, num_leaves=255, chunk=2, n_chunks=1,
+                tag=f"{n//1000}k/255leaf/strict", ds=ds2, split_batch=1,
+                learner=learner)
+            _record_point("higgs1m_255leaf_strict", value=round(ips2s, 3),
+                          cpu=cpu,
+                          steps_per_tree=st2s[-1] if st2s else None,
+                          batched_over_strict=round(
+                              ips2 / max(ips2s, 1e-9), 2))
+        except Exception as e:
+            _record_point("higgs1m_255leaf_strict",
+                          error=f"{type(e).__name__}: {e}"[:200])
+
+    if cpu:
+        return                       # 10M-row point is TPU-only
     # 10M-row scaling point (VERDICT r2 task 3b)
     try:
         x10 = np.concatenate([x] * 10, axis=0)
@@ -295,9 +355,9 @@ def child_extras() -> None:
             x10[sl] += (rng.standard_normal(
                 (N_ROWS, N_FEAT)).astype(np.float32) * 1e-3)
         y10 = np.concatenate([y] * 10)
-        ips3, auc3, _ = _train_point(lgb, x10, y10, num_leaves=31,
-                                     chunk=8, n_chunks=2,
-                                     tag="10M/31leaf/sb8", split_batch=8)
+        ips3, auc3, _, _ = _train_point(lgb, x10, y10, num_leaves=31,
+                                       chunk=8, n_chunks=2,
+                                       tag="10M/31leaf/sb8", split_batch=8)
         _record_point("higgs10m", value=round(ips3, 3),
                       auc=round(float(auc3), 4))
     except Exception as e:
@@ -352,10 +412,10 @@ def run_child(mode: str, timeout: int, extra_env=None, orphan=False):
     return out, err
 
 
-def _read_points():
+def _read_points(path=None):
     pts = []
     try:
-        with open(POINTS_FILE) as f:
+        with open(path or POINTS_FILE) as f:
             for line in f:
                 line = line.strip()
                 if line:
@@ -417,10 +477,45 @@ def main():
             line = _metric_line(out)
             if not line:
                 errors.append(f"primary-quick: {err or 'no JSON line'}")
+
+    # --- 2b. prefer a TPU point captured mid-round over any fallback ----
+    # tools/tpu_watch.py waits out the tunnel wedge all round and runs
+    # the same measurement children the moment a claim lands, appending
+    # to CAPTURE_FILE.  A real-hardware number measured hours ago beats
+    # a degraded CPU number measured now (VERDICT r4 task 1).
+    captured = None
+    points_src = POINTS_FILE
+    if not line:
+        # staleness guard: only trust captures from the last 12 h (one
+        # round) — the watcher also truncates the file at round start,
+        # but if no watcher ran this round an old point must not be
+        # attributed to current code
+        def _fresh(p):
+            try:
+                age = time.time() - time.mktime(
+                    time.strptime(p["t"], "%Y-%m-%dT%H:%M:%S"))
+                return age < 12 * 3600
+            except (KeyError, ValueError):
+                return False
+        cap = [p for p in _read_points(CAPTURE_FILE)
+               if p.get("point") == "primary" and not p.get("cpu")
+               and "value" in p and _fresh(p)]
+        if cap:
+            captured = cap[-1]
+            points_src = CAPTURE_FILE
+            line = json.dumps({k: captured[k] for k in
+                               ("metric", "value", "unit", "vs_baseline")
+                               if k in captured})
+            print(f"[bench] using mid-round TPU capture "
+                  f"({captured.get('t', 'no timestamp')})",
+                  file=sys.stderr, flush=True)
+
     degraded = None
+    cpu_fallback = False
     if not line:
         # last resort: reduced CPU run — an honest degraded number beats
         # none (and records the wedge diagnosis machine-readably)
+        cpu_fallback = True
         out, err = run_child("primary", timeout=CPU_TIMEOUT,
                              extra_env={"_BENCH_CPU": "1",
                                         "_BENCH_QUICK": "1"})
@@ -432,11 +527,15 @@ def main():
             errors.append(f"cpu-fallback: {err or 'no JSON line'}")
 
     # --- 3. extras in their own killable child --------------------------
-    # only when the TPU primary itself succeeded: a degraded CPU capture
-    # means the TPU path is broken and another 600 s child would burn
-    # the budget the capture discipline exists to protect
-    if line and tpu_ok and not degraded:
+    # TPU extras only when the TPU primary itself succeeded; on CPU
+    # fallback run the reduced-shape extras anyway so structural changes
+    # (super-step counts, batched-vs-strict ratio) always leave an
+    # empirical record (VERDICT r4 weak #1)
+    if line and tpu_ok and not degraded and not captured:
         run_child("extras", timeout=EXTRAS_TIMEOUT)
+    elif line and cpu_fallback:
+        run_child("extras", timeout=EXTRAS_TIMEOUT,
+                  extra_env={"_BENCH_CPU": "1"})
 
     # --- 4. merge + emit exactly one line -------------------------------
     if not line:
@@ -447,22 +546,38 @@ def main():
         return
     rec = json.loads(line)
     extra = {}
-    for p in _read_points():
+    for p in _read_points(points_src):
         name = p.get("point")
         if name in (None, "run_start", "probe", "final", "primary"):
             if name == "primary" and "auc" in p:
                 extra["higgs1m_31leaf_sb8_auc"] = p["auc"]
+                if p.get("steps_per_tree") is not None:
+                    extra["higgs1m_31leaf_sb8_steps"] = p["steps_per_tree"]
             continue
         if "value" in p:
             extra[name + "_iters_per_sec"] = p["value"]
-            if "auc" in p:
-                extra[name + "_auc"] = p["auc"]
-            if "vs_baseline" in p:
-                extra[name + "_vs_baseline"] = p["vs_baseline"]
+            for k_src, k_dst in (("auc", "_auc"),
+                                 ("vs_baseline", "_vs_baseline"),
+                                 ("steps_per_tree", "_steps"),
+                                 ("batched_over_strict", "_speedup"),
+                                 ("hist_tflops", "_hist_tflops"),
+                                 ("mfu", "_mfu"),
+                                 # reduced-shape CPU points must stay
+                                 # distinguishable from full-size TPU
+                                 # ones in the merged record
+                                 ("cpu", "_cpu"),
+                                 ("shape", "_shape")):
+                if p.get(k_src) is not None:
+                    extra[name + k_dst] = p[k_src]
         elif "error" in p:
             extra[name + "_error"] = p["error"]
     if extra:
         rec["extra"] = extra
+    if captured:
+        rec["note"] = ("primary + extras captured opportunistically "
+                       "mid-round by tools/tpu_watch.py at "
+                       f"{captured.get('t', '?')}; tunnel wedged at "
+                       "bench time")
     if degraded:
         rec["error"] = degraded
     _record_point("final", **rec)

@@ -58,6 +58,10 @@ class TreeArrays(NamedTuple):
     leaf_of_row: jax.Array       # [N] int32 — final row -> leaf assignment
     is_cat_node: jax.Array       # [L-1] bool — categorical split flags
     cat_rank: jax.Array          # [L-1, B] int32 — per-node bin decision rank
+    n_steps: jax.Array           # scalar int32 — grower loop steps taken
+    #                              (== splits for strict leaf-wise; < splits
+    #                              for split_batch>1 super-steps) — perf
+    #                              observability, not part of the model
 
 
 class _GrowState(NamedTuple):
@@ -685,6 +689,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             leaf_of_row=st.leaf_of_row,
             is_cat_node=st.is_cat_node,
             cat_rank=st.cat_rank,
+            n_steps=st.num_leaves - 1,
         )
 
     K = max(1, min(int(split_batch), L - 1)) if L > 1 else 1
@@ -937,7 +942,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         # state through the cond's no-op branch.  The loop exits the
         # moment the budget is exhausted or no leaf can split; the step
         # counter ``s`` is carried for the bynode RNG stream.
-        _, st = lax.while_loop(
+        s_final, st = lax.while_loop(
             lambda c: (~c[1].done) & (c[1].num_leaves < L), super_step,
             (jnp.int32(0), st))
         return TreeArrays(
@@ -958,6 +963,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             leaf_of_row=st.leaf_of_row,
             is_cat_node=st.is_cat_node[:L - 1],
             cat_rank=st.cat_rank[:L - 1],
+            n_steps=s_final,
         )
 
     fn = grow_tree_batched if K > 1 else grow_tree

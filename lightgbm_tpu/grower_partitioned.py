@@ -646,6 +646,7 @@ class PartitionedGrower:
             leaf_of_row=lor,
             is_cat_node=jnp.asarray(is_cat_node),
             cat_rank=jnp.asarray(cat_rank),
+            n_steps=jnp.int32(num_leaves - 1),
         )
 
     @staticmethod

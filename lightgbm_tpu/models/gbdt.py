@@ -384,6 +384,7 @@ class GBDTModel:
         self.models: List[Tree] = []          # host trees, grouped per iter
         self.device_trees: List[_DeviceTree] = []
         self.tree_weights: List[float] = []   # DART/RF reweighting
+        self.step_counts: List[int] = []      # grower loop steps per tree
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
         self._goss = config.data_sample_strategy == "goss"
         self._last_iter_state: Optional[dict] = None
@@ -922,6 +923,7 @@ class GBDTModel:
         for j in range(k):
             tj = TreeArrays(*(np.asarray(fld[j]) for fld in host))
             nl = int(tj.num_leaves)
+            self.step_counts.append(int(tj.n_steps))
             lvj = np.asarray(tj.leaf_value, np.float64).copy()
             if self._cegb_state is not None and nl > 1:
                 # mirror the in-graph CEGB used-set update on the host so
@@ -1057,6 +1059,9 @@ class GBDTModel:
             small = arrays._replace(leaf_of_row=arrays.num_leaves)
             host = jax.device_get(small)._replace(leaf_of_row=arrays.leaf_of_row)
             nl = int(host.num_leaves)
+            # perf observability: grower loop steps per tree (== splits
+            # for strict leaf-wise; the super-step count for split_batch)
+            self.step_counts.append(int(host.n_steps))
             if "cegb_used" in gkw and nl > 1:
                 self._cegb_state.used[
                     np.asarray(host.split_feature)[:nl - 1]] = True
@@ -1178,6 +1183,7 @@ class GBDTModel:
         del self.models[-self.num_class:]
         del self.device_trees[-self.num_class:]
         del self.tree_weights[-self.num_class:]
+        del self.step_counts[-self.num_class:]
         self.iter_ -= 1
         self._last_iter_state = None
 

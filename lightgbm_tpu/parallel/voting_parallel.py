@@ -93,7 +93,7 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         default_left=P(), left_child=P(), right_child=P(), split_gain=P(),
         leaf_value=P(), leaf_weight=P(), leaf_count=P(), internal_value=P(),
         internal_weight=P(), internal_count=P(), leaf_depth=P(),
-        leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P())
+        leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P(), n_steps=P())
 
     f = jax.shard_map(
         inner, mesh=mesh,

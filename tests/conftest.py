@@ -21,6 +21,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's wall clock is dominated by
+# XLA compiles (hundreds of jit variants across growers / shapes), and
+# every run used to pay them from scratch.  min_compile_time 0.5 s keeps
+# tiny kernels out of it.  The cache lives in the MACHINE-LOCAL temp dir,
+# not the repo: XLA:CPU AOT entries are machine-feature-specific (a
+# mismatched load warns of SIGILL), so a repo-synced cache moved to
+# different hardware would be a hazard.
+import getpass  # noqa: E402
+import tempfile  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(tempfile.gettempdir(),
+                               f"lgbtpu_jax_cache_{getpass.getuser()}"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
