@@ -191,6 +191,9 @@ class Dataset:
         self.bin_mappers: List[BinMapper] = []
         self.used_features: List[int] = []      # indices of non-trivial features
         self.binned: Optional[np.ndarray] = None  # [N, num_used] uint8/uint16
+        # k-hot sparse binned storage (sparse_data.py, the sparse_bin.hpp
+        # analog) — set INSTEAD of ``binned`` when it is smaller
+        self.binned_sparse = None
         self.bin_offsets: Optional[np.ndarray] = None  # [num_used+1] cumulative bins
         self.metadata: Optional[Metadata] = None
         self.feature_names: List[str] = []
@@ -298,7 +301,7 @@ class Dataset:
             self._fit_bin_mappers(colfn, cfg, cat_idx,
                                   sample_col_factory=sample_col_factory)
 
-        self._bin_data(colfn)
+        self._bin_data(colfn, cfg, csc if sparse_in else None)
         keep_raw = (not self.free_raw_data) or bool(cfg.linear_tree)
         if sparse_in:
             if cfg.linear_tree and self.num_total_features:
@@ -508,8 +511,55 @@ class Dataset:
         self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
         self.max_bin = max([2] + nbins)
 
-    def _bin_data(self, colfn) -> None:
+    def _try_sparse_bin(self, cfg, csc) -> bool:
+        """Sparse binned storage decision (sparse_bin.hpp:73 /
+        multi_val_sparse_bin.hpp analog — see sparse_data.py).
+
+        Taken only for scipy-sparse input with ``is_enable_sparse`` on:
+        collect the non-default-bin entries O(nnz) off the CSC layout,
+        then keep the padded k-hot layout iff it is smaller than the
+        dense (post-EFB bundled) matrix it replaces — for Allstate-class
+        width (13.2M x 4228, docs/Experiments.rst:32) that is ~4K bytes/row
+        vs G bytes/row, the difference between fitting one chip's HBM or
+        not.  Never chosen under linear_tree (needs dense raw values)."""
         nf = len(self.used_features)
+        if (cfg is None or csc is None or not cfg.is_enable_sparse
+                or cfg.linear_tree or nf == 0):
+            return False
+        from . import sparse_data as spd
+        stride = self.max_bin
+        rows, flat, default_bin = spd.collect_entries_csc(
+            csc, self.bin_mappers, self.used_features, stride)
+        counts = np.bincount(rows, minlength=self.num_data) if len(rows) \
+            else np.zeros(self.num_data, np.int64)
+        k = int(max(counts.max() if self.num_data else 0, 1))
+        sparse_bytes = self.num_data * k * 4
+        if self.efb is not None:
+            g = len(self.efb.group_num_bin)
+            # the grouped matrix's dtype follows the widest BUNDLE bin
+            # axis, not max_bin (bin_grouped) — bundles may exceed 256
+            elt = 1 if int(self.efb.group_num_bin.max()) <= 256 else 2
+        else:
+            g = nf
+            elt = 1 if self.max_bin <= 256 else 2
+        dense_bytes = self.num_data * g * elt
+        if sparse_bytes >= dense_bytes:
+            return False
+        self.binned_sparse = spd.build_khot(rows, flat, default_bin,
+                                            self.num_data, stride, nf,
+                                            counts=counts)
+        self.binned = None
+        self.efb = None     # the k-hot layout replaces bundling outright
+        from .utils.log import Log
+        Log.info(f"sparse binned storage: [N={self.num_data}, K={k}] k-hot "
+                 f"({sparse_bytes / 2**20:.1f} MB) chosen over dense "
+                 f"[N, {g}] ({dense_bytes / 2**20:.1f} MB)")
+        return True
+
+    def _bin_data(self, colfn, cfg=None, csc=None) -> None:
+        nf = len(self.used_features)
+        if self._try_sparse_bin(cfg, csc):
+            return
         if self.efb is not None:
             self.binned = bin_grouped(
                 lambda j: self.bin_mappers[self.used_features[j]]
@@ -526,6 +576,14 @@ class Dataset:
         """Per-feature binned matrix [N, F] (ungrouping EFB bundles if
         present) — for learners that take the flat layout."""
         self.construct()
+        if self.binned_sparse is not None:
+            if self.binned_sparse.nbytes() > 2**28:
+                from .utils.log import Log
+                Log.warning("densifying a large sparse-binned dataset "
+                            "([N, F] materialization) — prefer the serial/"
+                            "data-parallel learners, which consume the "
+                            "sparse layout directly")
+            return self.binned_sparse.densify()
         if self.efb is None:
             return self.binned
         nb = np.asarray([self.bin_mappers[f].num_bin
@@ -653,6 +711,7 @@ class Dataset:
             [self.feature_binned(), other.feature_binned()], axis=1)
         self.bin_offsets = None
         self.efb = None                # bundles no longer match columns
+        self.binned_sparse = None      # merged matrix is dense flat layout
         self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
         self.used_features = list(self.used_features) + [
             nt + f for f in other.used_features]
@@ -720,7 +779,9 @@ class Dataset:
         sub = Dataset.__new__(Dataset)
         sub.__dict__.update({k: v for k, v in self.__dict__.items()})
         sub.num_data = len(idx)
-        sub.binned = self.binned[idx]
+        sub.binned = self.binned[idx] if self.binned is not None else None
+        sub.binned_sparse = self.binned_sparse.subset_rows(idx) \
+            if self.binned_sparse is not None else None
         sub.raw_data = self.raw_data[idx] if self.raw_data is not None else None
         sub.metadata = Metadata(len(idx))
         if self.metadata.label is not None:
@@ -750,7 +811,6 @@ class Dataset:
         """Binary dataset cache (dataset.cpp SaveBinaryFile analog)."""
         self.construct()
         payload: Dict[str, Any] = {
-            "binned": self.binned,
             "bin_offsets": self.bin_offsets,
             "used_features": np.asarray(self.used_features, dtype=np.int32),
             "num_total_features": self.num_total_features,
@@ -758,6 +818,12 @@ class Dataset:
             "feature_names": np.asarray(self.feature_names, dtype=object),
             "num_mappers": len(self.bin_mappers),
         }
+        if self.binned_sparse is not None:
+            payload["sparse_flat"] = self.binned_sparse.flat
+            payload["sparse_default_bin"] = self.binned_sparse.default_bin
+            payload["sparse_stride"] = self.binned_sparse.stride
+        else:
+            payload["binned"] = self.binned
         for i, m in enumerate(self.bin_mappers):
             for k, v in m.to_state().items():
                 payload[f"mapper{i}_{k}"] = v
@@ -802,13 +868,22 @@ class Dataset:
         ds.free_raw_data = False
         ds._constructed = True
         ds._raw_input = None
-        ds.binned = z["binned"]
-        ds.bin_offsets = z["bin_offsets"]
         ds.used_features = [int(x) for x in z["used_features"]]
+        if "sparse_flat" in z.files:
+            from .sparse_data import SparseBinnedHost
+            ds.binned = None
+            ds.binned_sparse = SparseBinnedHost(
+                z["sparse_flat"], z["sparse_default_bin"],
+                int(z["sparse_stride"]), len(ds.used_features))
+            ds.num_data = ds.binned_sparse.flat.shape[0]
+        else:
+            ds.binned = z["binned"]
+            ds.binned_sparse = None
+            ds.num_data = ds.binned.shape[0]
+        ds.bin_offsets = z["bin_offsets"]
         ds.num_total_features = int(z["num_total_features"])
         ds.max_bin = int(z["max_bin"])
         ds.feature_names = [str(x) for x in z["feature_names"]]
-        ds.num_data = ds.binned.shape[0]
         n_mappers = int(z["num_mappers"])
         ds.bin_mappers = []
         for i in range(n_mappers):

@@ -61,8 +61,7 @@ def find_bundles(sample_bins: np.ndarray, num_bin: np.ndarray,
     """
     s, f = sample_bins.shape
     budget = int(max_conflict_rate * s)
-    nz = sample_bins != 0                       # [S, F] non-default mask
-    nz_count = nz.sum(axis=0)
+    nz_count = (sample_bins != 0).sum(axis=0)   # [F] non-default counts
 
     eligible = [j for j in range(f)
                 if not is_cat[j] and most_freq_bin[j] == 0
@@ -71,28 +70,66 @@ def find_bundles(sample_bins: np.ndarray, num_bin: np.ndarray,
     # conflict count; non-zero count is the same ordering at rate 0)
     eligible.sort(key=lambda j: -int(nz_count[j]))
 
+    # the reference caps the per-feature scan at max_search_group total:
+    # max_search_group-1 randomly sampled groups + the newest group
+    # (dataset.cpp:106 and :138, rand.Sample(last, max_search_group-1))
+    # — without the cap wide unbundleable data (Allstate-shaped 4228
+    # columns) degenerates to an O(F^2 * S) scan.  The scanned groups'
+    # conflict counts are ONE [search, S] @ [S] matvec per feature rather
+    # than a python loop of masked sums.
+    max_search_group = 100
+    grp_rng = np.random.RandomState(s)
+    # group occupancy rows are allocated geometrically as groups actually
+    # form (a full [eligible, S] matrix would be ~GBs on Allstate-shaped
+    # 4228 x 200k samples that bundle into a few dozen groups); the
+    # per-feature non-default mask is a strided column read, never a
+    # [S, F] bool materialization
+    cap = 64
+    mask_arr = np.zeros((cap, s), np.uint8)
+    bins_arr = np.zeros(cap, np.int64)                  # 1 + sum(nb-1)
+    confl_arr = np.zeros(cap, np.int64)
     groups: List[List[int]] = []
-    group_masks: List[np.ndarray] = []          # [S] bool occupancy
-    group_conflicts: List[int] = []
-    group_bins: List[int] = []                  # 1 + sum(nb-1)
+    ngr = 0
     for j in eligible:
-        placed = False
-        for gi in range(len(groups)):
-            if group_bins[gi] + int(num_bin[j]) - 1 > max_group_bins:
-                continue
-            conflicts = int((group_masks[gi] & nz[:, j]).sum())
-            if group_conflicts[gi] + conflicts <= budget:
-                groups[gi].append(j)
-                group_masks[gi] |= nz[:, j]
-                group_conflicts[gi] += conflicts
-                group_bins[gi] += int(num_bin[j]) - 1
-                placed = True
-                break
-        if not placed:
+        nb1 = int(num_bin[j]) - 1
+        nzj = (sample_bins[:, j] != 0).astype(np.uint8)
+        if ngr <= max_search_group:
+            search = np.arange(ngr)
+        else:
+            idx = grp_rng.choice(ngr - 1, size=max_search_group - 1,
+                                 replace=False)
+            search = np.concatenate([[ngr - 1], idx])
+        hit = -1
+        if len(search):
+            # int64 accumulation: a uint8 matvec would wrap counts at 256
+            # and admit heavily-conflicting features into "exclusive"
+            # bundles (the conflict sample is up to 200k rows)
+            counts = mask_arr[search] @ nzj.astype(np.int64)
+            ok = (bins_arr[search] + nb1 <= max_group_bins) \
+                & (confl_arr[search] + counts <= budget)
+            hits = np.nonzero(ok)[0]
+            if len(hits):
+                hit = int(hits[0])
+        if hit >= 0:
+            gi = int(search[hit])
+            groups[gi].append(j)
+            mask_arr[gi] |= nzj
+            confl_arr[gi] += int(counts[hit])
+            bins_arr[gi] += nb1
+        else:
+            if ngr == cap:
+                cap *= 2
+                mask_arr = np.concatenate(
+                    [mask_arr, np.zeros((cap - ngr, s), np.uint8)])
+                bins_arr = np.concatenate(
+                    [bins_arr, np.zeros(cap - ngr, np.int64)])
+                confl_arr = np.concatenate(
+                    [confl_arr, np.zeros(cap - ngr, np.int64)])
             groups.append([j])
-            group_masks.append(nz[:, j].copy())
-            group_conflicts.append(0)
-            group_bins.append(1 + int(num_bin[j]) - 1)
+            mask_arr[ngr] = nzj
+            bins_arr[ngr] = 1 + nb1
+            ngr += 1
+    group_bins = [int(b) for b in bins_arr[:ngr]]
 
     # drop the synthetic bin-0 for groups that stayed singletons, and add
     # singleton groups for ineligible features

@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 
 from .ops.histogram import compute_histogram
+from . import sparse_data as _spd
 from .ops.split import (SplitParams, SplitResult, find_best_split,
                         leaf_output, monotone_penalty_factor)
 
@@ -218,10 +219,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     def _hist(binned_view, vals, slot=None, nslots=1):
         """Reduced histogram; with ``slot`` a per-slot multi-histogram
         (split_batch) whose vals ⊗ onehot(slot) expansion happens inside
-        the scan (ops/histogram.py), never as an [N, 3*K] HBM buffer."""
-        h = compute_histogram(binned_view, vals, num_bins=Bh,
-                              block_rows=block_rows, slot=slot,
-                              num_slots=nslots)
+        the scan (ops/histogram.py), never as an [N, 3*K] HBM buffer.
+        Sparse-binned data takes the O(nnz) segment-sum formulation
+        (sparse_data.py) instead of the one-hot contraction."""
+        if isinstance(binned_view, _spd.SparseBinned):
+            h = _spd.histogram(binned_view, vals, num_bins=Bh, slot=slot,
+                               num_slots=nslots)
+        else:
+            h = compute_histogram(binned_view, vals, num_bins=Bh,
+                                  block_rows=block_rows, slot=slot,
+                                  num_slots=nslots)
         return reduce_fn(h)
 
     def _make_child_hist(n: int):
@@ -253,7 +260,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 def f(_):
                     idx = jnp.nonzero(in_child, size=cap, fill_value=n)[0]
                     safe = jnp.minimum(idx, n - 1)
-                    b_g = jnp.take(binned_view, safe, axis=0)
+                    if isinstance(binned_view, _spd.SparseBinned):
+                        b_g = binned_view.take_rows(safe)
+                    else:
+                        b_g = jnp.take(binned_view, safe, axis=0)
                     v_g = jnp.take(vals, safe, axis=0) \
                         * (idx < n)[:, None].astype(vals.dtype)
                     return _hist(b_g, v_g)
@@ -531,7 +541,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 # decision rank unifies numerical (iota rank) and
                 # categorical (ratio-order rank) predicates
                 if efb is None:
-                    fcol = jnp.take(binned, feat, axis=1).astype(jnp.int32)
+                    if isinstance(binned, _spd.SparseBinned):
+                        fcol = _spd.column(binned, feat)
+                    else:
+                        fcol = jnp.take(binned, feat, axis=1) \
+                            .astype(jnp.int32)
                 else:
                     # decode the feature's bins from its bundle column
                     # (SubFeatureIterator analog, feature_group.h)
@@ -759,9 +773,12 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 sl = jnp.maximum(slot, 0)
                 feat_r = feat_k[sl]                          # [N]
                 if efb is None:
-                    fcol = jnp.take_along_axis(
-                        binned, feat_r[:, None], axis=1)[:, 0] \
-                        .astype(jnp.int32)
+                    if isinstance(binned, _spd.SparseBinned):
+                        fcol = _spd.column_per_row(binned, feat_r)
+                    else:
+                        fcol = jnp.take_along_axis(
+                            binned, feat_r[:, None], axis=1)[:, 0] \
+                            .astype(jnp.int32)
                 else:
                     grp_r = efb.group_of_feat[feat_r]
                     gcol = jnp.take_along_axis(

@@ -49,12 +49,33 @@ class _DeviceTree:
 
 def _apply_tree(score_vec, binned, dt: _DeviceTree, na_bin, weight: float,
                 efb_maps=None):
-    """score_vec += weight * tree(binned)."""
+    """score_vec += weight * tree(binned) — dense or sparse-binned rows."""
+    from ..sparse_data import SparseBinned, add_tree_score_sparse
+    if isinstance(binned, SparseBinned):
+        return add_tree_score_sparse(
+            score_vec, binned, dt.split_feature, dt.threshold_bin,
+            dt.default_left, dt.left_child, dt.right_child, na_bin,
+            dt.is_cat_node, dt.cat_rank, dt.leaf_value,
+            jnp.float32(weight), steps=dt.steps)
     return add_tree_score(
         score_vec, binned, dt.split_feature, dt.threshold_bin,
         dt.default_left, dt.left_child, dt.right_child, na_bin,
         dt.is_cat_node, dt.cat_rank, dt.leaf_value, jnp.float32(weight),
         efb_maps, steps=dt.steps)
+
+
+def _tree_leaves(binned, dt: _DeviceTree, na_bin, efb_maps=None):
+    """Leaf id per row — dense or sparse-binned rows."""
+    from ..sparse_data import SparseBinned, traverse_tree_sparse
+    if isinstance(binned, SparseBinned):
+        return traverse_tree_sparse(
+            binned, dt.split_feature, dt.threshold_bin, dt.default_left,
+            dt.left_child, dt.right_child, na_bin, dt.is_cat_node,
+            dt.cat_rank, steps=dt.steps)
+    return traverse_tree_binned(
+        binned, dt.split_feature, dt.threshold_bin, dt.default_left,
+        dt.left_child, dt.right_child, na_bin, dt.is_cat_node,
+        dt.cat_rank, efb_maps, steps=dt.steps)
 
 
 class GBDTModel:
@@ -89,6 +110,17 @@ class GBDTModel:
             import jax
             learner = "partitioned" if jax.default_backend() == "cpu" \
                 else "masked"
+        if ds.binned_sparse is not None:
+            # sparse k-hot storage (sparse_data.py) is consumed by the
+            # one-program masked grower; the partitioned learner works on
+            # host-dense arrays, which would defeat the memory budget
+            if learner == "partitioned" and config.tpu_learner != "auto":
+                from ..utils.log import Log
+                Log.warning(
+                    "tpu_learner=partitioned overridden to masked: the "
+                    "dataset chose sparse binned storage (pass "
+                    "enable_sparse=false to keep the partitioned learner)")
+            learner = "masked"
 
         self.split_params = SplitParams(
             lambda_l1=config.lambda_l1,
@@ -143,6 +175,11 @@ class GBDTModel:
         has_node_controls = (mono_active and not mono_masked_ok) \
             or self._forced_spec is not None
 
+        if has_node_controls and ds.binned_sparse is not None:
+            raise ValueError(
+                "forced splits and monotone intermediate/advanced need the "
+                "host-orchestrated learner, which requires dense binned "
+                "storage; construct the Dataset with enable_sparse=false")
         if has_node_controls and learner != "partitioned" \
                 and config.tpu_learner == "auto":
             # node-level controls are host bookkeeping -> partitioned only
@@ -169,6 +206,7 @@ class GBDTModel:
         self._mesh = None
         self._row_pad = 0
         self._feat_pad = 0
+        self._global_counts = None
         self._dist_axis = "feature" if dist == "feature" else "data"
         if dist is not None and hist_reduce is None:
             self._mesh = self._resolve_mesh(config, self._dist_axis)
@@ -208,7 +246,42 @@ class GBDTModel:
         self._use_efb = (ds.efb is not None and hist_reduce is None
                          and learner in ("partitioned", "masked")
                          and dist in (None, "data"))
-        feat_binned = ds.binned if self._use_efb else ds.feature_binned()
+        # sparse k-hot storage rides the masked serial/data-parallel paths
+        # natively; feature/voting shard or vote per flat feature column,
+        # so they fall back to densified flat layout (feature_binned warns)
+        self._sparse = (ds.binned_sparse is not None and learner == "masked"
+                        and dist in (None, "data"))
+        if self._pc > 1 and dist == "data":
+            # each process chose its layout (and K) from its LOCAL rows;
+            # the jitted SPMD program needs one layout and one K across
+            # the pod.  Democratically: any dense rank demotes everyone
+            # to dense (it means dense was viable there), otherwise all
+            # ranks pad their entry axis to the pod-wide max K.
+            from jax.experimental import multihost_utils
+            mine = np.asarray([1 if self._sparse else 0,
+                               ds.binned_sparse.k
+                               if ds.binned_sparse is not None else 0],
+                              np.int64)
+            allinfo = np.asarray(multihost_utils.process_allgather(mine))
+            if self._sparse and int(allinfo[:, 0].min()) == 0:
+                from ..utils.log import Log
+                Log.info("sparse binned storage demoted to dense: another "
+                         "process's shard kept the dense layout")
+                self._sparse = False
+            elif self._sparse:
+                kmax = int(allinfo[:, 1].max())
+                sp = ds.binned_sparse
+                if sp.k < kmax:
+                    sp.flat = np.concatenate(
+                        [sp.flat, np.full((sp.flat.shape[0],
+                                           kmax - sp.k), -1, np.int32)],
+                        axis=1)
+        if self._sparse:
+            feat_binned = ds.binned_sparse.flat
+        elif self._use_efb:
+            feat_binned = ds.binned
+        else:
+            feat_binned = ds.feature_binned()
         num_bin = np.asarray([ds.bin_mappers[f].num_bin for f in ds.used_features],
                              np.int32)
         na_bin = np.asarray([ds.bin_mappers[f].na_bin for f in ds.used_features],
@@ -246,16 +319,22 @@ class GBDTModel:
                 from jax.experimental import multihost_utils
                 counts = np.asarray(multihost_utils.process_allgather(
                     np.asarray(self.num_data)))
+                # unpadded per-process row counts: global GOSS needs the
+                # true global N and this process's global row offset
+                self._global_counts = counts
                 ldev = max(n_sh // self._pc, 1)
                 target = -(-int(counts.max()) // ldev) * ldev
                 self._row_pad = target - self.num_data
             else:
                 self._row_pad = (-self.num_data) % n_sh
             if self._row_pad:
+                # sparse k-hot pads with -1 (no stored entries; the pad
+                # rows' vals are zeroed so the default-bin fix adds 0)
+                fill = -1 if self._sparse else 0
                 feat_binned = np.concatenate(
-                    [feat_binned, np.zeros((self._row_pad,
-                                            feat_binned.shape[1]),
-                                           feat_binned.dtype)], axis=0)
+                    [feat_binned, np.full((self._row_pad,
+                                           feat_binned.shape[1]), fill,
+                                          feat_binned.dtype)], axis=0)
             self.binned_dev = shard_rows(self._mesh, feat_binned,
                                          self._dist_axis)
         elif dist == "feature":
@@ -276,6 +355,13 @@ class GBDTModel:
             self.binned_dev = jnp.asarray(feat_binned)
         else:
             self.binned_dev = jnp.asarray(feat_binned)
+        if self._sparse:
+            # wrap the (possibly sharded) flat entry matrix as the pytree
+            # the grower/traversal paths dispatch on
+            from ..sparse_data import SparseBinned
+            self.binned_dev = SparseBinned(
+                self.binned_dev, jnp.asarray(ds.binned_sparse.default_bin),
+                ds.binned_sparse.stride, self.num_features)
 
         # split_batch resolution (config.py): 0 = auto -> strict leaf-wise
         # below 64 leaves, K-way super-steps above (PROFILE.md: the
@@ -307,7 +393,8 @@ class GBDTModel:
                 efb=self.efb_dev if self._use_efb else None,
                 split_batch=self._split_batch,
                 mono=self._mono if mono_masked_ok else None,
-                mono_penalty=config.monotone_penalty)
+                mono_penalty=config.monotone_penalty,
+                sparse=self._sparse)
         elif dist == "voting":
             from ..parallel.voting_parallel import make_voting_grower
             self.grower = make_voting_grower(
@@ -651,8 +738,11 @@ class GBDTModel:
     # -- plumbing ----------------------------------------------------------
     def add_valid_set(self, valid: Dataset) -> None:
         valid.construct(self.config)
-        binned = jnp.asarray(valid.binned if self._use_efb
-                             else valid.feature_binned())
+        if valid.binned_sparse is not None:
+            binned = valid.binned_sparse.to_device()
+        else:
+            binned = jnp.asarray(valid.binned if self._use_efb
+                                 else valid.feature_binned())
         init = np.zeros((valid.num_data, self.num_class), np.float32)
         if valid.metadata.init_score is not None:
             init += np.asarray(valid.metadata.init_score, np.float32) \
@@ -679,11 +769,8 @@ class GBDTModel:
             k = mi % self.num_class
             ht = self.models[mi] if mi < len(self.models) else None
             if ht is not None and ht.is_linear:
-                leaves = np.asarray(traverse_tree_binned(
-                    binned, dt.split_feature, dt.threshold_bin,
-                    dt.default_left, dt.left_child, dt.right_child,
-                    self.na_bin_dev, dt.is_cat_node, dt.cat_rank,
-                    self.efb_maps, steps=dt.steps))
+                leaves = np.asarray(_tree_leaves(
+                    binned, dt, self.na_bin_dev, self.efb_maps))
                 delta = self._linear_outputs(ht, leaves, valid.raw_data)
                 score = score.at[:, k].add(
                     self.tree_weights[mi] * jnp.asarray(delta, jnp.float32))
@@ -735,19 +822,46 @@ class GBDTModel:
         traced iteration index (fused-chunk path); defaults to the host
         counter so both paths draw identical per-iteration keys."""
         cfg = self.config
-        n = self.num_data
+        multi = self._pc > 1 and self._global_counts is not None
+        if multi:
+            # GLOBAL semantics under multi-process data-parallel
+            # (goss.hpp samples over the full data): the threshold is the
+            # global top_k-th |g|h and the Bernoulli draw is keyed by the
+            # row's GLOBAL index, so any process topology trains the same
+            # trees as a single process over the concatenated rows.
+            pidx = jax.process_index()
+            n = int(self._global_counts.sum())
+            offset = int(self._global_counts[:pidx].sum())
+        else:
+            n = self.num_data
+            offset = 0
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
         amp = (1.0 - cfg.top_rate) / cfg.other_rate
         absg = jnp.abs(g) * h
-        thresh = -jnp.sort(-absg)[top_k - 1]
+        if multi:
+            # the global top-k all lie inside the per-process local top-k:
+            # allgather each process's top min(k, local_n) candidates and
+            # take the k-th of the merged set
+            from jax.experimental import multihost_utils
+            cand = int(min(top_k, self.num_data))
+            local_top = np.full(top_k, -np.inf, np.float32)
+            local_top[:cand] = np.asarray(
+                jax.lax.top_k(absg, cand)[0], np.float32)
+            allc = np.asarray(multihost_utils.process_allgather(local_top))
+            thresh = jnp.float32(np.partition(allc.ravel(), -top_k)[-top_k])
+        else:
+            thresh = -jnp.sort(-absg)[top_k - 1]
         is_top = absg >= thresh
         if it is None:
             it = self.iter_
         key = jax.random.PRNGKey(cfg.bagging_seed + it)
-        if self._pc > 1:
+        if self._pc > 1 and not multi:
+            # multi-process WITHOUT the mesh data-parallel bookkeeping
+            # (caller-supplied hist_reduce hook): keep per-rank independent
+            # draws, matching _bagging_mask's fold-in
             key = jax.random.fold_in(key, jax.process_index())
-        u = jax.random.uniform(key, (n,))
+        u = jax.random.uniform(key, (n,))[offset:offset + self.num_data]
         p_other = other_k / jnp.maximum(n - top_k, 1)
         is_other = (~is_top) & (u < p_other)
         w = jnp.where(is_top, 1.0, jnp.where(is_other, amp, 0.0))
@@ -1142,11 +1256,8 @@ class GBDTModel:
             vdeltas = []
             for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
                 if linear:
-                    vleaves = np.asarray(traverse_tree_binned(
-                        vbinned, dt.split_feature, dt.threshold_bin,
-                        dt.default_left, dt.left_child, dt.right_child,
-                        self.na_bin_dev, dt.is_cat_node, dt.cat_rank,
-                        self.efb_maps, steps=dt.steps))
+                    vleaves = np.asarray(_tree_leaves(
+                        vbinned, dt, self.na_bin_dev, self.efb_maps))
                     vdelta = self._linear_outputs(ht, vleaves, vds.raw_data) \
                         - (init_scores[k] if init_scores[k] != 0.0 else 0.0)
                     vd = jnp.asarray(vdelta, jnp.float32)

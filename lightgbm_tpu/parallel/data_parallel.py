@@ -59,7 +59,7 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    params: SplitParams, max_depth: int = -1,
                    block_rows: int = 0, axis: str = "data", efb=None,
                    split_batch: int = 1, mono=None,
-                   mono_penalty: float = 0.0):
+                   mono_penalty: float = 0.0, sparse: bool = False):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
     Inputs: binned [N, F] (or the bundled [N, G] group matrix when ``efb``
@@ -86,6 +86,38 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         leaf_value=P(), leaf_weight=P(), leaf_count=P(), internal_value=P(),
         internal_weight=P(), internal_count=P(), leaf_depth=P(),
         leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P(), n_steps=P())
+
+    if sparse:
+        # SparseBinned pytree (sparse_data.py): the flat [N, K] entry
+        # matrix shards on rows while the [F] default_bin vector is
+        # replicated — a single prefix spec cannot describe both leaves,
+        # so the wrapper ships the leaves as separate shard_map arguments
+        # and rebuilds the pytree inside (stride/F are static aux, cached
+        # per shape).
+        from ..sparse_data import SparseBinned
+        cache = {}
+
+        def _sparse_fn(stride: int, nf: int):
+            def wrapped(flat, db, vals, fm, nb, nab, nabp, ic):
+                return inner(SparseBinned(flat, db, stride, nf), vals,
+                             fm, nb, nab, nabp, ic)
+            return jax.shard_map(
+                wrapped, mesh=mesh,
+                in_specs=(P(axis, None), P(None), P(axis, None),
+                          P(), P(), P(), P(), P()),
+                out_specs=out_specs, check_vma=False)
+
+        def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
+            if is_cat is None:
+                is_cat = jnp.zeros(num_bin.shape[0], bool)
+            key = (binned.stride, binned.num_features)
+            if key not in cache:
+                cache[key] = jax.jit(_sparse_fn(*key))
+            return cache[key](binned.flat, binned.default_bin, vals,
+                              feature_mask, num_bin, na_bin, na_bin,
+                              is_cat)
+
+        return grow
 
     f = jax.shard_map(
         inner, mesh=mesh,

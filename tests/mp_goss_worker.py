@@ -1,0 +1,80 @@
+"""Worker for the multi-process GOSS equality test
+(tests/test_multiprocess.py::test_two_process_goss_matches_single).
+
+Each process: launch.init -> deterministic global data -> bin mappers
+fitted on the FULL global data (identically on every process, so binning
+is topology-invariant and any tree difference is attributable to GOSS
+semantics) -> local row shard -> GBDT training with
+data_sample_strategy=goss over the 2-process mesh -> rank 0 dumps the
+trees.  The host test trains single-process on the same mappers and
+requires tree-for-tree equality — the contract that the GOSS top-rate
+threshold and Bernoulli draws are GLOBAL (goss.hpp samples over the full
+data; models/gbdt.py _goss_vals multi-process branch)."""
+
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    out = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.parallel import launch
+
+    launch.init(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=nproc, process_id=rank)
+
+    import numpy as np
+    from lightgbm_tpu import Dataset, train
+    from tests_goss_shared import GOSS_PARAMS, ROUNDS, global_data, \
+        full_data_mappers, tree_records, synthetic_grads, shard_bounds
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    x, y = global_data()
+    mappers = full_data_mappers(x)
+
+    shard = launch.row_shard(x, y)
+    params = dict(GOSS_PARAMS, num_machines=nproc, tree_learner="data")
+    ds = Dataset(shard.x, label=shard.y, bin_mappers=mappers,
+                 params=params)
+    bst = train(params, ds, num_boost_round=ROUNDS)
+
+    # the semantic contract, tested EXACTLY: the GOSS weight vector for
+    # this process's rows must be the corresponding slice of the
+    # single-process weight vector (same synthetic gradients)
+    m = bst._model
+    g_full, h_full = synthetic_grads(len(y))
+    lo, hi = shard_bounds(len(y), nproc)[rank]
+    w0 = np.asarray(m._goss_vals(jnp.asarray(g_full[lo:hi]),
+                                 jnp.asarray(h_full[lo:hi]), it=0))
+    import jax
+    dbg = {
+        "pc": int(jax.process_count()),
+        "counts": [int(c) for c in m._global_counts],
+        "u8": [float(v) for v in np.asarray(jax.random.uniform(
+            jax.random.PRNGKey(m.config.bagging_seed), (4096,)))[:8]],
+        "seed": int(m.config.bagging_seed),
+    }
+
+    if rank == 0:
+        with open(out, "w") as f:
+            json.dump({"trees": tree_records(bst),
+                       "w0_rank0": w0.tolist(), "dbg": dbg,
+                       "pred_head": bst.predict(x[:256]).tolist()}, f)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

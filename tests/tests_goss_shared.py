@@ -1,0 +1,71 @@
+"""Shared fixtures for the multi-process GOSS equality test — imported by
+both the spawned worker (tests/mp_goss_worker.py) and the host test, so
+data, params, and mapper fitting are byte-identical in every topology."""
+
+import numpy as np
+
+GOSS_PARAMS = {
+    "objective": "binary",
+    "num_leaves": 15,
+    "min_data_in_leaf": 5,
+    "max_bin": 63,
+    "data_sample_strategy": "goss",
+    "top_rate": 0.2,
+    "other_rate": 0.15,
+    "bagging_seed": 5,
+    "tpu_learner": "masked",   # the topology-invariant learner
+    "verbosity": -1,
+}
+ROUNDS = 5
+
+
+def global_data(n=4096, f=10, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float64)
+    y = (x[:, 0] - 0.7 * x[:, 1] + 0.3 * rng.randn(n) > 0) \
+        .astype(np.float32)
+    return x, y
+
+
+def full_data_mappers(x):
+    """Bin mappers fitted on the FULL data — deterministic, so every
+    process (and the single-process reference) bins identically."""
+    from lightgbm_tpu.binning import BinMapper
+    from lightgbm_tpu.config import Config
+    cfg = Config(dict(GOSS_PARAMS))
+    mappers = []
+    for j in range(x.shape[1]):
+        m = BinMapper()
+        m.find_bin(x[:, j], len(x), cfg.max_bin,
+                   cfg.min_data_in_bin, use_missing=cfg.use_missing,
+                   zero_as_missing=cfg.zero_as_missing)
+        mappers.append(m)
+    return mappers
+
+
+def tree_records(bst):
+    """Structure + leaf values for every tree, for cross-topology
+    comparison."""
+    recs = []
+    for t in bst._model.models:
+        recs.append({
+            "split_feature": [int(v) for v in t.split_feature],
+            "threshold_bin": [int(v) for v in t.threshold_bin],
+            "leaf_value": [float(v) for v in t.leaf_value],
+        })
+    return recs
+
+
+def synthetic_grads(n, seed=11):
+    """Varied deterministic gradients so GOSS's two strata are non-trivial
+    (constant |g|h would make every row 'top')."""
+    rng = np.random.RandomState(seed)
+    g = rng.randn(n).astype(np.float32)
+    h = np.full(n, 0.25, np.float32)
+    return g, h
+
+
+def shard_bounds(n, nproc):
+    """The contiguous row partition launch.row_shard uses."""
+    parts = np.array_split(np.arange(n), nproc)
+    return [(int(p[0]), int(p[-1]) + 1) for p in parts]
