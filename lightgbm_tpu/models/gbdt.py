@@ -252,16 +252,31 @@ class GBDTModel:
         self._sparse = (ds.binned_sparse is not None and learner == "masked"
                         and dist in (None, "data"))
         if self._pc > 1 and dist == "data":
-            # each process chose its layout (and K) from its LOCAL rows;
-            # the jitted SPMD program needs one layout and one K across
-            # the pod.  Democratically: any dense rank demotes everyone
-            # to dense (it means dense was viable there), otherwise all
-            # ranks pad their entry axis to the pod-wide max K.
+            # each process chose its binned layout (sparse k-hot vs
+            # dense, EFB bundles vs flat, entry width K) from its LOCAL
+            # rows; the jitted SPMD program needs ONE layout across the
+            # pod.  Consensus: any dense rank demotes everyone to dense
+            # (it means dense was viable there); dense ranks keep EFB
+            # only when EVERY rank holds the IDENTICAL bundle structure
+            # (bundles are fitted on per-rank samples, so shards can
+            # disagree, and a sparse-chooser dropped its bundles
+            # outright) — otherwise the whole pod uses the flat [N, F]
+            # layout; all-sparse pods pad the entry axis to the max K.
             from jax.experimental import multihost_utils
+            efb_sig = 0
+            if self._use_efb:
+                import hashlib
+                hsh = hashlib.sha256()
+                for a in (ds.efb.group_of_feat, ds.efb.off_of_feat,
+                          ds.efb.group_num_bin,
+                          [len(g) for g in ds.efb.groups],
+                          [j for g in ds.efb.groups for j in g]):
+                    hsh.update(np.asarray(a, np.int64).tobytes())
+                efb_sig = int.from_bytes(hsh.digest()[:7], "big")
             mine = np.asarray([1 if self._sparse else 0,
                                ds.binned_sparse.k
-                               if ds.binned_sparse is not None else 0],
-                              np.int64)
+                               if ds.binned_sparse is not None else 0,
+                               efb_sig], np.int64)
             allinfo = np.asarray(multihost_utils.process_allgather(mine))
             if self._sparse and int(allinfo[:, 0].min()) == 0:
                 from ..utils.log import Log
@@ -276,6 +291,15 @@ class GBDTModel:
                         [sp.flat, np.full((sp.flat.shape[0],
                                            kmax - sp.k), -1, np.int32)],
                         axis=1)
+            if not self._sparse:
+                sigs = allinfo[:, 2]
+                if self._use_efb and not (sigs == sigs[0]).all():
+                    from ..utils.log import Log
+                    Log.info("EFB bundles dropped pod-wide: processes "
+                             "disagree on the bundle structure (per-rank "
+                             "sample bundling); using the flat layout")
+                if not (sigs == sigs[0]).all() or int(sigs[0]) == 0:
+                    self._use_efb = False
         if self._sparse:
             feat_binned = ds.binned_sparse.flat
         elif self._use_efb:
@@ -669,7 +693,10 @@ class GBDTModel:
         initial score comes from the GLOBAL label/weight statistics
         (binary_objective.hpp BoostFromScore runs after a network
         allreduce of suml/sumw), not this process's shard."""
-        if self._pc <= 1 or self._dist is None:
+        if self._pc <= 1 or self._dist is None or self._dist == "feature":
+            # feature-parallel replicates the data: every process already
+            # holds the GLOBAL metadata, and gathering would only
+            # duplicate each row process_count times
             return self.objective.boost_from_score(class_id)
         from jax.experimental import multihost_utils
         obj = self.objective
@@ -800,9 +827,11 @@ class GBDTModel:
         n = self.num_data
         epoch = (it // cfg.bagging_freq) * cfg.bagging_freq
         key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed), epoch)
-        if self._pc > 1:
+        if self._pc > 1 and self._dist != "feature":
             # per-host independent draws (the reference seeds its bagging
-            # RNG per rank the same way, gbdt.cpp bagging_rand_)
+            # RNG per rank the same way, gbdt.cpp bagging_rand_).
+            # feature-parallel replicates the rows, so every process MUST
+            # draw the SAME mask or the pod's split statistics diverge.
             key = jax.random.fold_in(key, jax.process_index())
         u = jax.random.uniform(key, (n,))
         pos_f, neg_f = cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
@@ -856,10 +885,12 @@ class GBDTModel:
         if it is None:
             it = self.iter_
         key = jax.random.PRNGKey(cfg.bagging_seed + it)
-        if self._pc > 1 and not multi:
+        if self._pc > 1 and not multi and self._dist != "feature":
             # multi-process WITHOUT the mesh data-parallel bookkeeping
             # (caller-supplied hist_reduce hook): keep per-rank independent
-            # draws, matching _bagging_mask's fold-in
+            # draws, matching _bagging_mask's fold-in.  feature-parallel
+            # replicates the rows — identical draws on every process, so
+            # the single-process sampling IS already global
             key = jax.random.fold_in(key, jax.process_index())
         u = jax.random.uniform(key, (n,))[offset:offset + self.num_data]
         p_other = other_k / jnp.maximum(n - top_k, 1)

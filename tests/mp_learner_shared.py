@@ -1,0 +1,50 @@
+"""Shared fixtures for the multi-process feature-/voting-parallel
+topology tests — imported by both the spawned worker
+(tests/mp_learner_worker.py) and the host test, so data, params, and
+mapper fitting are byte-identical in every topology."""
+
+import numpy as np
+
+PARAMS = {
+    "objective": "binary",
+    "num_leaves": 15,
+    "min_data_in_leaf": 5,
+    "max_bin": 63,
+    "learning_rate": 0.2,
+    "verbosity": -1,
+}
+ROUNDS = 5
+
+# row-sampling variants: under feature-parallel the rows are REPLICATED
+# per process, so the sampling draws must be identical on every rank
+# (gbdt.py skips the per-rank RNG fold-in for dist == "feature") — these
+# exercise exactly that contract
+VARIANTS = {
+    "": {},
+    "goss": {"data_sample_strategy": "goss", "top_rate": 0.2,
+             "other_rate": 0.15, "bagging_seed": 5},
+    "bag": {"bagging_fraction": 0.7, "bagging_freq": 1,
+            "bagging_seed": 5},
+}
+
+
+def global_data(n=4096, f=12, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float64)
+    y = (x[:, 0] - 0.7 * x[:, 1] + 0.4 * x[:, 2]
+         + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return x, y
+
+
+def full_data_mappers(x):
+    from lightgbm_tpu.binning import BinMapper
+    from lightgbm_tpu.config import Config
+    cfg = Config(dict(PARAMS))
+    mappers = []
+    for j in range(x.shape[1]):
+        m = BinMapper()
+        m.find_bin(x[:, j], len(x), cfg.max_bin,
+                   cfg.min_data_in_bin, use_missing=cfg.use_missing,
+                   zero_as_missing=cfg.zero_as_missing)
+        mappers.append(m)
+    return mappers
