@@ -89,7 +89,20 @@ class Booster:
             raise ValueError("Booster needs train_set, model_file or model_str")
 
         self.config = Config(params or {})
-        self.train_set = train_set.construct(self.config)
+        # reference _update_params semantics (basic.py: train-time params
+        # are update()d ONTO the dataset's own params): a not-yet-
+        # constructed dataset bins with its OWN params as the base and
+        # the booster's params overriding — a Dataset(params={'max_bin':
+        # 63}) keeps its 63 bins when the booster params don't mention
+        # binning.  The C API relies on this: LGBM_DatasetCreateFromMat
+        # carries the binning params, LGBM_BoosterCreate the training
+        # params (c_api.cpp bins at dataset-create time).
+        construct_cfg = self.config
+        if not train_set._constructed and train_set.params:
+            from .config import canonical_params
+            construct_cfg = Config({**canonical_params(train_set.params),
+                                    **canonical_params(params or {})})
+        self.train_set = train_set.construct(construct_cfg)
         self.objective = create_objective(self.config)
         self._model = create_boosting(self.config, self.train_set,
                                       self.objective, hist_reduce)
@@ -772,7 +785,14 @@ class Booster:
             else list(self.tree_weights)
         old_iter = (self._model.iter_ if self._model is not None
                     else len(old_models) // self._num_tree_per_iteration)
-        new_train = train_set.construct(self.config)
+        cfg = self.config
+        if not train_set._constructed and train_set.params:
+            # dataset params are the binning base (see __init__); the
+            # booster's training params override
+            from .config import canonical_params
+            cfg = Config({**canonical_params(train_set.params),
+                          **canonical_params(self.config.raw_params)})
+        new_train = train_set.construct(cfg)
         if old_models and new_train.raw_data is None:
             # without raw values the existing ensemble cannot be scored
             # on the new data — continuing would silently train as if

@@ -218,6 +218,20 @@ for _name, (_t, _d, _al) in _PARAMS.items():
     for _a in _al:
         _ALIASES[_a] = _name
 
+
+def canonical_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Keys alias-resolved to canonical names (first writer wins among
+    aliases within one dict, matching _set's alias priority).  Use when
+    MERGING two param dicts — a raw {**a, **b} lets an alias in one dict
+    silently coexist with the canonical name in the other, and _set's
+    first-writer rule would then pick the wrong source."""
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        name = _ALIASES.get(k, k)
+        if name not in out:
+            out[name] = v
+    return out
+
 # Objective aliases (config_auto.cpp ParseObjectiveAlias analog)
 _OBJECTIVE_ALIASES = {
     "regression": "regression", "regression_l2": "regression", "l2": "regression",

@@ -227,11 +227,22 @@ class Dataset:
             built = getattr(self, "_built_bin_sig", None)
             if config is not None and built is not None \
                     and self._bin_signature(config) != built:
-                from .utils.log import Log
-                Log.warning(
-                    "Ignoring binning params passed at train time: "
-                    f"Dataset was already constructed with {built}; pass "
-                    "params to the Dataset constructor instead")
+                # warn only about binning params the caller EXPLICITLY
+                # passed (a booster config carries defaults for every
+                # param — a dataset built with its own max_bin would
+                # otherwise warn on every construct(self.config) touch)
+                from .config import _ALIASES
+                explicit = {_ALIASES.get(k, k) for k in config.raw_params}
+                sig_now = self._bin_signature(config)
+                conflict = {k for k, v in sig_now.items()
+                            if k in explicit and built.get(k) != v}
+                if conflict:
+                    from .utils.log import Log
+                    Log.warning(
+                        "Ignoring binning params passed at train time "
+                        f"({sorted(conflict)}): Dataset was already "
+                        f"constructed with {built}; pass params to the "
+                        "Dataset constructor instead")
             return self
         cfg = config or Config(self.params)
         self._built_bin_sig = self._bin_signature(cfg)

@@ -207,6 +207,12 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     cfg = Config(params)
     if cfg.num_iterations != 100 and num_boost_round == 100:
         num_boost_round = cfg.num_iterations
+    if not train_set._constructed and train_set.params:
+        # dataset's own params are the binning base, cv params override
+        # (reference _update_params semantics — see Booster.__init__)
+        from .config import canonical_params
+        cfg = Config({**canonical_params(train_set.params),
+                      **canonical_params(params)})
     train_set.construct(cfg)
 
     if folds is None:

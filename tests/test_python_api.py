@@ -266,3 +266,22 @@ def test_reset_training_data_requires_raw(bst):
     ds.raw_data = None
     with pytest.raises(ValueError, match="raw values"):
         b.reset_training_data(ds)
+
+
+def test_dataset_params_are_binning_base():
+    """Reference _update_params semantics (basic.py: train params are
+    update()d ONTO dataset params): Dataset(params={'max_bin': k}) keeps
+    its k bins when the train-time params don't mention binning — the
+    lifecycle every C-API client uses (binning params at DatasetCreate,
+    training params at BoosterCreate)."""
+    x, y = _data(seed=12)
+    ds = lgb.Dataset(x, label=y, params={"max_bin": 15, "verbosity": -1})
+    b = lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1}, ds, num_boost_round=2)
+    assert max(m.num_bin for m in b.train_set.bin_mappers) <= 16
+    # train-time params still OVERRIDE on conflict
+    ds2 = lgb.Dataset(x, label=y, params={"max_bin": 15, "verbosity": -1})
+    b2 = lgb.train({"objective": "binary", "num_leaves": 7, "max_bin": 31,
+                    "verbosity": -1}, ds2, num_boost_round=2)
+    nb = max(m.num_bin for m in b2.train_set.bin_mappers)
+    assert 16 < nb <= 32, nb
