@@ -1,0 +1,74 @@
+/* R .C-convention shim over the LGBM_Train* C ABI (libcapi_train.so).
+ *
+ * R's .C foreign-function interface passes every argument as a pointer
+ * and cannot return opaque handles, so — exactly like the reference's
+ * own R-package glue (R-package/src/lightgbm_R.cpp wraps c_api.h calls
+ * behind R-callable entry points) — a thin C shim adapts the ABI to the
+ * calling convention.  This one drives the full train lifecycle
+ * (dataset create -> set label -> booster create -> N UpdateOneIter ->
+ * SaveModel -> PredictForMat) in one call; granular handle-table
+ * wrappers would follow the same pattern.
+ *
+ * R matrices arrive COLUMN-major (Fortran layout); the ABI wants
+ * row-major, so the shim transposes.  Labels arrive as R doubles and
+ * are narrowed to the float32 the "label" field stores.
+ *
+ * Build:  gcc -O2 -shared -fPIC lgbtpu_shim.c -o lgbtpu_shim.so \
+ *             /path/to/libcapi_train.so -Wl,-rpath,<dir-of-libcapi> \
+ *             -Wl,-rpath,<dir-of-libpythonX.Y>
+ * Use:    dyn.load("lgbtpu_shim.so"); .C("lgbtpu_smoke", ...) — see
+ *         smoke.R next to this file.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* H;
+extern const char* LGBM_TrainGetLastError(void);
+extern int LGBM_TrainDatasetCreateFromMat(const double*, int, int,
+                                          const char*, H, H*);
+extern int LGBM_TrainDatasetSetField(H, const char*, const void*, int, int);
+extern int LGBM_TrainDatasetFree(H);
+extern int LGBM_TrainBoosterCreate(H, const char*, H*);
+extern int LGBM_TrainBoosterUpdateOneIter(H, int*);
+extern int LGBM_TrainBoosterSaveModel(H, int, int, const char*);
+extern int LGBM_TrainBoosterPredictForMat(H, const double*, int, int, int,
+                                          int, int, long long, double*,
+                                          long long*);
+extern int LGBM_TrainBoosterFree(H);
+
+#define CHECK(rc) do { if ((rc) != 0) {                                   \
+    fprintf(stderr, "lgbtpu_smoke: %s\n", LGBM_TrainGetLastError());      \
+    goto cleanup; } } while (0)
+
+void lgbtpu_smoke(double* x_colmajor, int* n_, int* f_, double* y_,
+                  char** ds_params, char** bst_params, int* rounds_,
+                  char** model_path, double* out_pred, int* status) {
+  int n = *n_, f = *f_, i, j, fin = 0;
+  long long out_len = 0;
+  H ds = 0, bst = 0;
+  double* x = (double*)malloc(sizeof(double) * (size_t)n * (size_t)f);
+  float* y = (float*)malloc(sizeof(float) * (size_t)n);
+  *status = 1;
+  if (!x || !y) goto cleanup;
+  for (i = 0; i < n; ++i)
+    for (j = 0; j < f; ++j)
+      x[(size_t)i * f + j] = x_colmajor[(size_t)j * n + i];
+  for (i = 0; i < n; ++i) y[i] = (float)y_[i];
+
+  CHECK(LGBM_TrainDatasetCreateFromMat(x, n, f, ds_params[0], 0, &ds));
+  CHECK(LGBM_TrainDatasetSetField(ds, "label", y, n, 0));
+  CHECK(LGBM_TrainBoosterCreate(ds, bst_params[0], &bst));
+  for (i = 0; i < *rounds_; ++i)
+    CHECK(LGBM_TrainBoosterUpdateOneIter(bst, &fin));
+  if (model_path[0] && model_path[0][0])
+    CHECK(LGBM_TrainBoosterSaveModel(bst, 0, -1, model_path[0]));
+  CHECK(LGBM_TrainBoosterPredictForMat(bst, x, n, f, 0, 0, -1, n,
+                                       out_pred, &out_len));
+  *status = (out_len == n) ? 0 : 2;
+cleanup:
+  if (bst) LGBM_TrainBoosterFree(bst);
+  if (ds) LGBM_TrainDatasetFree(ds);
+  free(x);
+  free(y);
+}

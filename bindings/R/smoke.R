@@ -1,0 +1,41 @@
+# R binding smoke test for lightgbm_tpu's LGBM_Train* C ABI.
+#
+# Usage:
+#   Rscript smoke.R <lgbtpu_shim.so> <x.csv> <y.csv> <model.txt> <pred.csv>
+#
+# Loads the .C-convention shim (lgbtpu_shim.c), trains 5 boosting
+# iterations on the CSV data, saves the model in the reference text
+# format, and writes the predictions — which the pytest harness
+# (tests/test_r_binding.py) compares against the Python API trained on
+# identical data.  The reference's R package drives c_api.h through the
+# same dyn.load + C-glue pattern (R-package/R/lgb.train.R ->
+# lightgbm_R.cpp).
+
+a <- commandArgs(trailingOnly = TRUE)
+stopifnot(length(a) == 5)
+shim <- a[[1]]; xcsv <- a[[2]]; ycsv <- a[[3]]
+model <- a[[4]]; predcsv <- a[[5]]
+
+dyn.load(shim)
+stopifnot(is.loaded("lgbtpu_smoke"))
+
+x <- as.matrix(read.csv(xcsv, header = FALSE))
+y <- scan(ycsv, quiet = TRUE)
+n <- nrow(x); f <- ncol(x)
+stopifnot(length(y) == n)
+
+r <- .C("lgbtpu_smoke",
+        as.double(x),                    # column-major; shim transposes
+        as.integer(n), as.integer(f),
+        as.double(y),
+        "max_bin=63 verbosity=-1",
+        "objective=binary num_leaves=15 learning_rate=0.1 verbosity=-1",
+        as.integer(5),
+        model,
+        pred = double(n),
+        status = integer(1))
+stopifnot(r$status == 0)
+
+write(r$pred, predcsv, ncolumns = 1)
+cat(sprintf("R smoke ok: n=%d f=%d acc=%.3f\n", n, f,
+            mean((r$pred > 0.5) == (y > 0.5))))

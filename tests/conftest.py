@@ -42,10 +42,12 @@ import pytest  # noqa: E402
 
 # Skip budget (VERDICT r2: a regressing guard skipped instead of failing
 # and nobody noticed).  On the standard harness — virtual 8-device CPU
-# mesh, full toolchain — only the graphviz-executable plotting skip is
-# expected.  Every new skip must either be fixed or the budget consciously
-# raised here with a comment.
-SKIP_BUDGET = 1
+# mesh, full toolchain — exactly two skips are expected: the
+# graphviz-executable plotting skip and the R-binding smoke test
+# (test_r_binding.py, needs Rscript; its shim-compile/link guard still
+# RUNS without R).  Every new skip must either be fixed or the budget
+# consciously raised here with a comment.
+SKIP_BUDGET = 2
 _skips: list = []
 
 

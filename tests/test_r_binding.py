@@ -1,0 +1,146 @@
+"""Foreign-language FFI smoke test (VERDICT r4 task 8): prove the
+"any FFI language binds libcapi_train.so" claim with a REAL R binding —
+the reference ships an R package whose glue is exactly this pattern
+(R-package/src/lightgbm_R.cpp: C shim + dynamic load).
+
+The test compiles bindings/R/lgbtpu_shim.c against libcapi_train.so,
+runs bindings/R/smoke.R under Rscript (dataset create, 5 training
+iterations, SaveModel, predict), and asserts the R-side predictions and
+saved model match the Python API trained on identical data.  Skips when
+R is absent (this is the one environment-dependent skip besides
+graphviz — see conftest SKIP_BUDGET); the shim still gets compiled and
+its symbols checked, so the binding surface itself is guarded even
+without R.
+"""
+
+import os
+import shutil
+import subprocess
+import sysconfig
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from test_capi_train import SO, _ensure_built
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SHIM_SRC = os.path.join(os.path.dirname(HERE), "bindings", "R",
+                        "lgbtpu_shim.c")
+SMOKE_R = os.path.join(os.path.dirname(HERE), "bindings", "R", "smoke.R")
+
+_BUILD_ERR = _ensure_built()
+pytestmark = pytest.mark.skipif(bool(_BUILD_ERR), reason=_BUILD_ERR)
+
+
+def _data(n=1500, f=6, seed=4):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, f)
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+def _build_shim(tmp_path) -> str:
+    shim = str(tmp_path / "lgbtpu_shim.so")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    subprocess.run(
+        ["cc", "-O2", "-shared", "-fPIC", SHIM_SRC, "-o", shim, SO,
+         f"-Wl,-rpath,{os.path.dirname(SO)}", f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True, text=True)
+    return shim
+
+
+def test_shim_compiles_and_links(tmp_path):
+    """The R shim builds and resolves every LGBM_Train* symbol it uses —
+    guarded even on machines without R."""
+    shim = _build_shim(tmp_path)
+    nm = subprocess.run(["nm", "-D", "--undefined-only", shim],
+                        capture_output=True, text=True, check=True).stdout
+    # ld resolved the LGBM symbols against libcapi_train.so at link
+    # time (they appear as undefined in the shim, satisfied by the
+    # NEEDED entry); ldd proves the dependency edge exists
+    ldd = subprocess.run(["ldd", shim], capture_output=True, text=True,
+                         check=True).stdout
+    assert "libcapi_train.so" in ldd
+    assert "lgbtpu_smoke" in subprocess.run(
+        ["nm", "-D", shim], capture_output=True, text=True,
+        check=True).stdout
+
+
+def test_shim_lifecycle_as_r_would_call_it(tmp_path):
+    """Drive lgbtpu_smoke through ctypes with EXACTLY R's .C calling
+    convention — column-major doubles, every argument a pointer, strings
+    as char** — so the shim's transpose/narrowing/lifecycle logic is
+    behavior-tested even on machines without R."""
+    import ctypes
+    shim = _build_shim(tmp_path)
+    lib = ctypes.CDLL(shim)
+    x, y = _data()
+    n, f = x.shape
+    x_col = np.asfortranarray(x).ravel(order="F")   # R memory layout
+    y_d = y.astype(np.float64)
+    pred = np.zeros(n, np.float64)
+    status = ctypes.c_int(-1)
+    n_c, f_c, rounds = ctypes.c_int(n), ctypes.c_int(f), ctypes.c_int(5)
+    model = str(tmp_path / "model.txt").encode()
+
+    def charpp(s):
+        return (ctypes.c_char_p * 1)(s)
+
+    lib.lgbtpu_smoke(
+        x_col.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(n_c), ctypes.byref(f_c),
+        y_d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        charpp(b"max_bin=63 verbosity=-1"),
+        charpp(b"objective=binary num_leaves=15 learning_rate=0.1 "
+               b"verbosity=-1"),
+        ctypes.byref(rounds), charpp(model),
+        pred.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(status))
+    assert status.value == 0
+
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.1, "max_bin": 63, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(x, label=y), num_boost_round=5)
+    np.testing.assert_allclose(pred, bst.predict(x), rtol=1e-6, atol=1e-8)
+    from_c = lgb.Booster(model_file=model.decode()).predict(x)
+    np.testing.assert_allclose(from_c, pred, rtol=1e-6, atol=1e-8)
+
+
+def test_r_smoke_matches_python(tmp_path):
+    """dyn.load + .C from a real R process: train 5 iters, predict,
+    compare predictions and the saved model to the Python API."""
+    if shutil.which("Rscript") is None:
+        pytest.skip("R (Rscript) not installed on this machine")
+    shim = _build_shim(tmp_path)
+    x, y = _data()
+    xcsv = tmp_path / "x.csv"
+    ycsv = tmp_path / "y.csv"
+    model = tmp_path / "model.txt"
+    predcsv = tmp_path / "pred.csv"
+    np.savetxt(xcsv, x, delimiter=",", fmt="%.17g")
+    np.savetxt(ycsv, y, fmt="%g")
+
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(HERE),
+               LGBM_TPU_FORCE_CPU="1",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    r = subprocess.run(
+        ["Rscript", SMOKE_R, shim, str(xcsv), str(ycsv), str(model),
+         str(predcsv)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "R smoke ok" in r.stdout
+
+    # Python API on identical data/params — same trees, same predictions
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.1, "max_bin": 63, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(x, label=y), num_boost_round=5)
+    ref = bst.predict(x)
+    r_pred = np.loadtxt(predcsv)
+    # CSV round-trips x at %.17g (exact for float64); binning and
+    # training are deterministic, so parity is tight
+    np.testing.assert_allclose(r_pred, ref, rtol=1e-6, atol=1e-8)
+    # the R-saved model loads in Python and predicts identically
+    from_r = lgb.Booster(model_file=str(model)).predict(x)
+    np.testing.assert_allclose(from_r, r_pred, rtol=1e-6, atol=1e-8)
