@@ -40,6 +40,10 @@ from typing import Any, List, NamedTuple, Optional
 
 import numpy as np
 
+from .sklearn import (LGBMClassifier as _SkClassifier,
+                      LGBMRanker as _SkRanker,
+                      LGBMRegressor as _SkRegressor)
+
 
 class WorkerContext(NamedTuple):
     """What every spawned worker receives (dask.py passes the same facts
@@ -77,13 +81,18 @@ def run(entry: str, num_workers: int = 2, *,
         base_port: Optional[int] = None,
         backend: str = "cpu",
         args: Any = None,
+        rank_args: Optional[List[Any]] = None,
         timeout: int = 600,
         extra_pythonpath: Optional[List[str]] = None) -> List[Any]:
     """Spawn ``num_workers`` coordinated training processes on this
     machine and return their results rank-ordered.
 
     entry: ``"module:function"`` — imported in each worker; called as
-      ``function(ctx)`` or ``function(ctx, args)`` when ``args`` given.
+      ``function(ctx)``, ``function(ctx, args)`` when ``args`` given, or
+      ``function(ctx, args, rank_args[rank])`` when ``rank_args`` given.
+    rank_args: one value PER RANK, serialized separately so each worker
+      unpickles only its own (a worker's data partition must not be
+      shipped to — or held by — every other worker).
     hosts: one entry per worker for a REAL cluster (the function then
       only prints the per-host command lines — a cluster scheduler, not
       this process, must start them); default localhost spawning.
@@ -116,6 +125,15 @@ def run(entry: str, num_workers: int = 2, *,
         args_path = os.path.join(tmp, "args.pkl")
         with open(args_path, "wb") as f:
             pickle.dump(args, f)
+    rank_args_paths = [""] * num_workers
+    if rank_args is not None:
+        if len(rank_args) != num_workers:
+            raise ValueError(f"rank_args has {len(rank_args)} entries "
+                             f"for {num_workers} workers")
+        for rank, ra in enumerate(rank_args):
+            rank_args_paths[rank] = os.path.join(tmp, f"rank{rank}.pkl")
+            with open(rank_args_paths[rank], "wb") as f:
+                pickle.dump(ra, f)
 
     # worker output goes to FILES, not pipes: the workers run coordinated
     # collectives, so blocking on one worker's full pipe buffer would
@@ -130,6 +148,8 @@ def run(entry: str, num_workers: int = 2, *,
                "--backend", backend]
         if args_path:
             cmd += ["--args", args_path]
+        if rank_args_paths[rank]:
+            cmd += ["--rank-args", rank_args_paths[rank]]
         log = open(os.path.join(tmp, f"r{rank}.log"), "w+")
         logs.append(log)
         procs.append(subprocess.Popen(cmd, env=env, stdout=log,
@@ -219,6 +239,254 @@ def train(params: dict, x: np.ndarray, y: Optional[np.ndarray] = None, *,
     return _engine_train(p, ds, num_boost_round=num_boost_round, **kw)
 
 
+# ---------------------------------------------------------------------------
+# Estimator layer (dask.py:1092-1417 DaskLGBMClassifier/Regressor/Ranker
+# analog, minus Dask itself): sklearn-style estimators whose fit() runs
+# over a pod of coordinated worker processes via :func:`run`, training
+# directly on PRE-PARTITIONED per-worker data (the dask-collection
+# partition model) or partitioning a global array for you.
+
+def _fit_worker(ctx: WorkerContext, args: dict, part: tuple):
+    """Per-worker fit body (dask.py:_train_part analog): spawned by
+    :func:`run` inside an initialized pod with ONLY this rank's data
+    part (run's rank_args — no worker ever holds another's partition);
+    trains with globally-consistent bin mappers and returns the
+    (replicated) model plus fit-result attributes."""
+    from . import Dataset, train as _engine_train
+    from .callback import record_evaluation
+    from .config import Config
+    from .parallel import launch
+    import jax
+
+    pc = jax.process_count()
+    x, y, w, g = part
+    p = dict(args["params"])
+    p.setdefault("num_machines", pc)
+    rounds = args["rounds"]
+
+    cfg = Config(dict(p, num_iterations=rounds))
+    # categorical columns participate in the distributed FindBin as
+    # categories, mirroring the single-process sklearn path (and
+    # distributed.train's cat_idx handling)
+    cat_spec = str(getattr(cfg, "categorical_feature", "") or "")
+    cat = {int(t) for t in cat_spec.split(",") if t.strip().isdigit()} \
+        or None
+    mappers = launch.global_bin_mappers(
+        np.asarray(x)[:int(p.get("bin_construct_sample_cnt", 200000))],
+        cfg, cat_idx=cat)
+    ds = Dataset(x, label=y, weight=w, group=g, params=p,
+                 bin_mappers=mappers)
+
+    valid_sets, valid_names, evals = [], [], {}
+    for i, (vx, vy, vw, vg) in enumerate(args.get("eval_set") or []):
+        valid_sets.append(Dataset(vx, label=vy, weight=vw, group=vg,
+                                  reference=ds))
+        names = args.get("eval_names")
+        valid_names.append(names[i] if names else f"valid_{i}")
+    cbs = [record_evaluation(evals)] if valid_sets else None
+    bst = _engine_train(p, ds, num_boost_round=rounds,
+                        valid_sets=valid_sets or None,
+                        valid_names=valid_names or None, callbacks=cbs)
+    return {"model": bst.model_to_string(),
+            "evals": evals,
+            "best_iteration": bst.best_iteration,
+            "best_score": dict(bst.best_score),
+            "n_features": int(np.asarray(x).shape[1])}
+
+
+def _split_parts(arr, n: int, row_splits: Optional[List[np.ndarray]]):
+    if arr is None:
+        return [None] * n
+    if isinstance(arr, (list, tuple)):
+        if len(arr) != n:
+            raise ValueError(
+                f"pre-partitioned input has {len(arr)} parts for "
+                f"{n} workers — one part per worker")
+        return [np.asarray(a) for a in arr]
+    arr = np.asarray(arr)
+    if row_splits is not None:
+        return [arr[idx] for idx in row_splits]
+    return [np.asarray(a) for a in np.array_split(arr, n)]
+
+
+class _DistLGBMModel:
+    """Mixin carrying the distributed fit (dask.py:_DaskLGBMModel role:
+    the launcher knobs ride the estimator, fit fans out, the fitted
+    state loads back into the plain sklearn estimator)."""
+
+    def _set_dist(self, n_workers: int, backend: str, timeout: int):
+        self.n_workers = int(n_workers)
+        self._dist_backend = backend
+        self._dist_timeout = int(timeout)
+
+    def _encode_eval_label(self, y: np.ndarray) -> np.ndarray:
+        """eval_set labels through the same transform as the training
+        labels (classifier overrides with the fitted class encoding)."""
+        return self._process_label(y)
+
+    def _dist_fit(self, X, y, sample_weight=None, group=None,
+                  eval_set=None, eval_names=None):
+        params = self._lgb_params()
+        tl = params.setdefault("tree_learner", "data")
+        if tl == "feature":
+            raise ValueError(
+                "the estimator layer partitions ROWS across workers; "
+                "tree_learner=feature replicates rows and shards "
+                "features — use lightgbm_tpu.distributed.train directly "
+                "for that topology, or tree_learner=data|voting here")
+        n = self.n_workers
+        pre_partitioned = isinstance(X, (list, tuple))
+        row_splits = None
+        if not pre_partitioned and group is not None:
+            # partition at query boundaries (dask requires group-aligned
+            # partitions the same way, dask.py _train group handling)
+            sizes = np.asarray(group, np.int64)
+            if len(sizes) < n:
+                raise ValueError(
+                    f"cannot partition {len(sizes)} query groups across "
+                    f"{n} workers — every worker needs at least one "
+                    "whole group (reduce n_workers)")
+            bounds = np.concatenate([[0], np.cumsum(sizes)])
+            gsplil = np.array_split(np.arange(len(sizes)), n)
+            row_splits = [np.arange(bounds[gi[0]], bounds[gi[-1] + 1])
+                          for gi in gsplil]
+            group = [sizes[gi] for gi in gsplil]
+        xp = _split_parts(X, n, row_splits)
+        yp = _split_parts(y, n, row_splits)
+        wp = _split_parts(sample_weight, n, row_splits)
+        gp = _split_parts(group, n, None) if group is not None \
+            else [None] * n
+        evs = None
+        if eval_set:
+            evs = []
+            for tup in eval_set:
+                vx, vy = tup[0], tup[1]
+                evs.append((np.asarray(vx),
+                            self._encode_eval_label(np.asarray(vy)), None,
+                            None))
+        args = {"params": params, "rounds": self.n_estimators,
+                "eval_set": evs, "eval_names": eval_names}
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        results = run("lightgbm_tpu.distributed:_fit_worker",
+                      num_workers=n, backend=self._dist_backend,
+                      args=args,
+                      rank_args=[(xp[i], yp[i], wp[i], gp[i])
+                                 for i in range(n)],
+                      timeout=self._dist_timeout,
+                      extra_pythonpath=[repo_root])
+        r0 = results[0]
+        from .booster import Booster
+        self._Booster = Booster(model_str=r0["model"])
+        self._n_features = r0["n_features"]
+        self.best_iteration_ = r0["best_iteration"]
+        self.best_score_ = r0["best_score"]
+        self._evals_result = r0["evals"]
+        self.fitted_ = True
+        self.n_iter_ = (self.best_iteration_
+                        if self.best_iteration_ and self.best_iteration_ > 0
+                        else self._Booster.current_iteration)
+        self.objective_ = params.get("objective")
+        return self
+
+    def to_local(self):
+        """The plain single-process estimator carrying the fitted model
+        (dask.py to_local analog)."""
+        from . import sklearn as _sk
+        cls = getattr(_sk, type(self).__name__.replace("Distributed", ""))
+        local = cls(**self.get_params())
+        for attr in ("_Booster", "_n_features", "_classes", "_n_classes",
+                     "best_iteration_", "best_score_", "_evals_result",
+                     "fitted_", "n_iter_", "objective_"):
+            if hasattr(self, attr):
+                setattr(local, attr, getattr(self, attr))
+        return local
+
+
+class DistributedLGBMRegressor(_DistLGBMModel, _SkRegressor):
+    """Distributed version of LGBMRegressor (dask.py:1268
+    DaskLGBMRegressor analog): ``fit(X, y)`` trains over ``n_workers``
+    coordinated processes; ``X``/``y`` may be global arrays (partitioned
+    for you) or lists of per-worker parts (pre-distributed data)."""
+
+    def __init__(self, *args, n_workers: int = 2, backend: str = "cpu",
+                 timeout: int = 600, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._set_dist(n_workers, backend, timeout)
+
+    def fit(self, X, y, sample_weight=None, eval_set=None,
+            eval_names=None, **_):
+        y = [np.asarray(p, np.float32) for p in y] \
+            if isinstance(y, (list, tuple)) \
+            else np.asarray(y, np.float32)
+        return self._dist_fit(X, y, sample_weight=sample_weight,
+                              eval_set=eval_set, eval_names=eval_names)
+
+
+class DistributedLGBMClassifier(_DistLGBMModel, _SkClassifier):
+    """Distributed version of LGBMClassifier (dask.py:1092 analog)."""
+
+    def __init__(self, *args, n_workers: int = 2, backend: str = "cpu",
+                 timeout: int = 600, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._set_dist(n_workers, backend, timeout)
+
+    def fit(self, X, y, sample_weight=None, eval_set=None,
+            eval_names=None, **_):
+        parts = isinstance(y, (list, tuple))
+        sizes = [len(p) for p in y] if parts else None
+        y_all = np.concatenate([np.asarray(p) for p in y]) if parts \
+            else np.asarray(y)
+        self._classes, y_enc = np.unique(y_all, return_inverse=True)
+        self._n_classes = len(self._classes)
+        if self._n_classes > 2:
+            self._other_params.setdefault("num_class", self._n_classes)
+        if isinstance(sample_weight, (list, tuple)):
+            # per-part weights concatenate for the (global) class-weight
+            # multiply, then re-split with the labels below
+            sample_weight = np.concatenate(
+                [np.asarray(p) for p in sample_weight])
+        w = self._class_weights(sample_weight, y_enc)
+        y_enc = y_enc.astype(np.float32)
+        if parts:
+            cuts = np.cumsum(sizes)[:-1]
+            y_enc = list(np.split(y_enc, cuts))
+            if w is not None:
+                w = list(np.split(np.asarray(w), cuts))
+        return self._dist_fit(X, y_enc, sample_weight=w,
+                              eval_set=eval_set, eval_names=eval_names)
+
+    def _encode_eval_label(self, y: np.ndarray) -> np.ndarray:
+        idx = np.searchsorted(self._classes, y)
+        idx = np.clip(idx, 0, len(self._classes) - 1)
+        if not np.array_equal(self._classes[idx], y):
+            raise ValueError(
+                "eval_set contains labels not present in the training "
+                f"classes {list(self._classes)}")
+        return idx.astype(np.float32)
+
+
+class DistributedLGBMRanker(_DistLGBMModel, _SkRanker):
+    """Distributed version of LGBMRanker (dask.py:1417 analog): global
+    input is partitioned at query-group boundaries; pre-partitioned
+    input takes one ``group`` array per part."""
+
+    def __init__(self, *args, n_workers: int = 2, backend: str = "cpu",
+                 timeout: int = 600, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._set_dist(n_workers, backend, timeout)
+
+    def fit(self, X, y, group=None, sample_weight=None, eval_set=None,
+            eval_names=None, **_):
+        if group is None:
+            raise ValueError("DistributedLGBMRanker requires group")
+        y = [np.asarray(p, np.float32) for p in y] \
+            if isinstance(y, (list, tuple)) \
+            else np.asarray(y, np.float32)
+        return self._dist_fit(X, y, sample_weight=sample_weight,
+                              group=group, eval_set=eval_set,
+                              eval_names=eval_names)
+
+
 def _main(argv: List[str]) -> None:
     """Worker bootstrap (what ``run`` spawns): init the collective
     runtime BEFORE any backend exists, then hand control to the entry."""
@@ -230,6 +498,7 @@ def _main(argv: List[str]) -> None:
     ap.add_argument("--machines", required=True)
     ap.add_argument("--result", default="")
     ap.add_argument("--args", default="")
+    ap.add_argument("--rank-args", default="")
     ap.add_argument("--backend", default="cpu")
     ns = ap.parse_args(argv)
 
@@ -251,9 +520,15 @@ def _main(argv: List[str]) -> None:
                         machines=ns.machines,
                         local_listen_port=int(
                             entries[ns.rank].rsplit(":", 1)[1]))
+    shared = None
     if ns.args:
         with open(ns.args, "rb") as f:
-            result = fn(ctx, pickle.load(f))
+            shared = pickle.load(f)
+    if ns.rank_args:
+        with open(ns.rank_args, "rb") as f:
+            result = fn(ctx, shared, pickle.load(f))
+    elif ns.args:
+        result = fn(ctx, shared)
     else:
         result = fn(ctx)
     if ns.result:

@@ -693,10 +693,14 @@ class GBDTModel:
         initial score comes from the GLOBAL label/weight statistics
         (binary_objective.hpp BoostFromScore runs after a network
         allreduce of suml/sumw), not this process's shard."""
-        if self._pc <= 1 or self._dist is None or self._dist == "feature":
+        if self._pc <= 1 or self._dist is None or self._dist == "feature" \
+                or getattr(self.objective, "is_ranking", False):
             # feature-parallel replicates the data: every process already
             # holds the GLOBAL metadata, and gathering would only
-            # duplicate each row process_count times
+            # duplicate each row process_count times.  Ranking objectives
+            # boost from 0 regardless of data (rank_objective.hpp), so
+            # the gathered metadata — which would also need global query
+            # boundaries — is never consulted.
             return self.objective.boost_from_score(class_id)
         from jax.experimental import multihost_utils
         obj = self.objective

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Restart the TPU capture watcher (tools/tpu_watch.py) safely: the
+# pattern lives in this FILE, not the caller's command line, so pkill
+# can't match the invoking shell.  Never touches probe/bench children
+# (claim holders must not be killed — see tpu_watch.py docstring).
+cd "$(dirname "$0")/.."
+for pid in $(pgrep -f "tpu_watch\.py --deadline"); do
+    kill "$pid" 2>/dev/null
+done
+sleep 1
+nohup python tools/tpu_watch.py --deadline-hours "${1:-10}" \
+    > /dev/null 2>&1 &
+echo "watcher restarted (pid $!)"

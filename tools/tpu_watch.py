@@ -48,8 +48,11 @@ def log(msg: str) -> None:
         f.write(line + "\n")
 
 
-def spawn_probe() -> None:
-    """One orphaned claim probe; never killed (see module docstring)."""
+def spawn_probe() -> subprocess.Popen:
+    """One orphaned claim probe; never killed (see module docstring) —
+    but a probe that EXITS on its own (e.g. 'TPU backend setup/compile
+    error (Unavailable)' when the relay is mid-wedge or mid-handover)
+    holds nothing, so the caller may safely spawn a replacement."""
     code = ("import time,sys\n"
             "t0=time.time()\n"
             "import jax\n"
@@ -57,9 +60,9 @@ def spawn_probe() -> None:
             "print('PROBE_OK', d[0].device_kind, round(time.time()-t0,2),"
             " flush=True)\n")
     with open(PROBE_OUT, "w") as out:
-        subprocess.Popen([sys.executable, "-c", code], stdout=out,
-                         stderr=subprocess.STDOUT,
-                         start_new_session=True)
+        return subprocess.Popen([sys.executable, "-c", code], stdout=out,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
 
 
 def run_bench_child(mode: str, timeout: int) -> bool:
@@ -111,8 +114,9 @@ def main() -> None:
     except OSError:
         pass
     log(f"watch start; capture -> {CAPTURE}")
-    spawn_probe()
+    probe = spawn_probe()
     t_probe = time.time()
+    retry_backoff = 60
     while time.time() < deadline:
         time.sleep(POLL_S)
         try:
@@ -130,7 +134,18 @@ def main() -> None:
                 else 0
             log(f"capture finished; {n} points in {CAPTURE}; exiting")
             return
-        if int(time.time() - t_probe) % 600 < POLL_S:
+        if probe.poll() is not None:
+            # the probe FAILED (exited without a grant) — it holds no
+            # claim, so replacing it is safe; back off so a hard-down
+            # relay isn't hammered
+            tail = out.strip().splitlines()[-1] if out.strip() else "(empty)"
+            log(f"probe exited rc={probe.returncode} without a grant "
+                f"({tail!r}); respawning in {retry_backoff}s")
+            time.sleep(retry_backoff)
+            retry_backoff = min(retry_backoff * 2, 1800)
+            probe = spawn_probe()
+            t_probe = time.time()
+        elif int(time.time() - t_probe) % 600 < POLL_S:
             log(f"still waiting on claim ({time.time() - t_probe:.0f}s; "
                 "orphan parked, tunnel presumed wedged)")
     log("deadline reached; probe orphan left parked; exiting")
