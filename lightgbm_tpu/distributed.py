@@ -271,9 +271,19 @@ def _fit_worker(ctx: WorkerContext, args: dict, part: tuple):
     cat_spec = str(getattr(cfg, "categorical_feature", "") or "")
     cat = {int(t) for t in cat_spec.split(",") if t.strip().isdigit()} \
         or None
-    mappers = launch.global_bin_mappers(
-        np.asarray(x)[:int(p.get("bin_construct_sample_cnt", 200000))],
-        cfg, cat_idx=cat)
+    k_sample = int(p.get("bin_construct_sample_cnt", 200000))
+    if _is_sparse(x):
+        x = x.tocsr()
+        # densifying the sample is bounded by an element budget, not
+        # just a row count — wide-sparse input (the k-hot storage's
+        # whole reason to exist) would otherwise materialize
+        # rows x FULL-width float64 here
+        k_sample = min(k_sample,
+                       max(256, 50_000_000 // max(1, x.shape[1])))
+        sample = x[:k_sample].toarray()
+    else:
+        sample = np.asarray(x)[:k_sample]
+    mappers = launch.global_bin_mappers(sample, cfg, cat_idx=cat)
     ds = Dataset(x, label=y, weight=w, group=g, params=p,
                  bin_mappers=mappers)
 
@@ -291,10 +301,22 @@ def _fit_worker(ctx: WorkerContext, args: dict, part: tuple):
             "evals": evals,
             "best_iteration": bst.best_iteration,
             "best_score": dict(bst.best_score),
-            "n_features": int(np.asarray(x).shape[1])}
+            "n_features": int(x.shape[1])}
+
+
+def _is_sparse(a) -> bool:
+    try:
+        import scipy.sparse as sp
+        return sp.issparse(a)
+    except ImportError:
+        return False
 
 
 def _split_parts(arr, n: int, row_splits: Optional[List[np.ndarray]]):
+    """Contiguous per-worker row parts; scipy-sparse matrices pass
+    through row-sliced (the Dataset consumes CSR/CSC natively — see
+    sparse_data.py — so densifying here would defeat the k-hot binned
+    storage on exactly the wide inputs that need it)."""
     if arr is None:
         return [None] * n
     if isinstance(arr, (list, tuple)):
@@ -302,11 +324,14 @@ def _split_parts(arr, n: int, row_splits: Optional[List[np.ndarray]]):
             raise ValueError(
                 f"pre-partitioned input has {len(arr)} parts for "
                 f"{n} workers — one part per worker")
-        return [np.asarray(a) for a in arr]
-    arr = np.asarray(arr)
+        return [a.tocsr() if _is_sparse(a) else np.asarray(a)
+                for a in arr]
+    # CSR row-slices/indexes like an ndarray; COO/DOK/BSR do not
+    arr = arr.tocsr() if _is_sparse(arr) else np.asarray(arr)
     if row_splits is not None:
         return [arr[idx] for idx in row_splits]
-    return [np.asarray(a) for a in np.array_split(arr, n)]
+    bounds = np.linspace(0, arr.shape[0], n + 1).astype(int)
+    return [arr[bounds[i]:bounds[i + 1]] for i in range(n)]
 
 
 class _DistLGBMModel:
@@ -361,7 +386,8 @@ class _DistLGBMModel:
             evs = []
             for tup in eval_set:
                 vx, vy = tup[0], tup[1]
-                evs.append((np.asarray(vx),
+                vx = vx.tocsr() if _is_sparse(vx) else np.asarray(vx)
+                evs.append((vx,
                             self._encode_eval_label(np.asarray(vy)), None,
                             None))
         args = {"params": params, "rounds": self.n_estimators,

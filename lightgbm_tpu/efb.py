@@ -78,6 +78,15 @@ def find_bundles(sample_bins: np.ndarray, num_bin: np.ndarray,
     # conflict counts are ONE [search, S] @ [S] matvec per feature rather
     # than a python loop of masked sums.
     max_search_group = 100
+    # ...but only as a FALLBACK: the sampled subset hits the one
+    # compatible group with probability ~max_search_group/ngr, which
+    # shatters real bundles on data with hundreds of them (400 exclusive
+    # 5-blocks collapsed to 400 groups under exact search degrade to
+    # ~1600 under blind sampling).  The cap exists to bound the
+    # O(F * ngr * S) scan on DEGENERATE width (unbundleable data where
+    # ngr ~ F); below full_search_groups the exact matvec is affordable,
+    # so correctness wins and the sample only kicks in past it.
+    full_search_groups = 512
     grp_rng = np.random.RandomState(s)
     # group occupancy rows are allocated geometrically as groups actually
     # form (a full [eligible, S] matrix would be ~GBs on Allstate-shaped
@@ -93,7 +102,7 @@ def find_bundles(sample_bins: np.ndarray, num_bin: np.ndarray,
     for j in eligible:
         nb1 = int(num_bin[j]) - 1
         nzj = (sample_bins[:, j] != 0).astype(np.uint8)
-        if ngr <= max_search_group:
+        if ngr <= full_search_groups:
             search = np.arange(ngr)
         else:
             idx = grp_rng.choice(ngr - 1, size=max_search_group - 1,

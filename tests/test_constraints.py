@@ -1,8 +1,12 @@
 """Monotone + interaction constraint tests
 (test_engine.py:1508-1670 monotone constraints analog, SURVEY.md §4)."""
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow   # exhaustive sweep tier (docs/Testing.md)
+
+
+import numpy as np
 
 import lightgbm_tpu as lgb
 

@@ -102,14 +102,20 @@ class TestLayoutOps:
 class TestDatasetSelection:
     def test_sparse_chosen_for_wide_sparse_input(self):
         rng = np.random.default_rng(5)
-        x = _rand_sparse(rng, 400, 600, 40)
-        y = rng.normal(size=400)
+        # a shape where k-hot decisively beats dense/EFB: enough rows
+        # that every column pair overlaps somewhere (~5 shared rows
+        # expected), so exclusive bundling is impossible and the
+        # dense alternative stays [N, ~F] wide while k stays ~nnz/row
+        # (the old 400x600x40 shape sat on the size crossover and
+        # flipped when the bundling search improved)
+        x = _rand_sparse(rng, 2000, 600, 30)
+        y = rng.normal(size=2000)
         ds = Dataset(x, label=y).construct(Config({"min_data_in_leaf": 5}))
         assert ds.binned_sparse is not None
         assert ds.binned is None
-        assert ds.binned_sparse.flat.shape[0] == 400
+        assert ds.binned_sparse.flat.shape[0] == 2000
         # the layout really is smaller than the dense alternative
-        assert ds.binned_sparse.nbytes() < 400 * ds.num_features
+        assert ds.binned_sparse.nbytes() < 2000 * ds.num_features
 
     def test_dense_kept_for_narrow_input(self):
         rng = np.random.default_rng(6)
@@ -128,8 +134,8 @@ class TestDatasetSelection:
 
     def test_subset_and_binary_roundtrip(self, tmp_path):
         rng = np.random.default_rng(8)
-        x = _rand_sparse(rng, 400, 600, 40)
-        y = rng.normal(size=400)
+        x = _rand_sparse(rng, 2000, 600, 30)  # see size-crossover note above
+        y = rng.normal(size=2000)
         ds = Dataset(x, label=y).construct(Config({}))
         assert ds.binned_sparse is not None
         sub = ds.subset(np.arange(100, 200))

@@ -9,8 +9,12 @@ growth.  These tests pin: model validity, near-parity of quality, exact
 fused==per-iteration equality, and serial==distributed agreement.
 """
 
-import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow   # exhaustive sweep tier (docs/Testing.md)
+
+
+import numpy as np
 from sklearn.metrics import roc_auc_score
 
 import lightgbm_tpu as lgb
