@@ -1,0 +1,45 @@
+"""Machine-keyed persistent XLA compilation cache.
+
+One call makes every jit compile in this process reusable by later
+processes on the SAME host: the cache directory is keyed by the host's
+CPU feature fingerprint because XLA:CPU AOT entries are
+machine-specific and this can run in environments that migrate between
+heterogeneous hosts — a cache written on one host fails every load on
+another ("Target machine feature ... is not supported"), costing the
+failed loads on top of the recompiles (measured: 25 cold minutes for
+the test suite).  Used by tests/conftest.py, the spawned multi-process
+pod workers, and ``lightgbm_tpu.distributed`` worker bootstrap — pod
+tests pay dozens of fresh-process compiles per run without it.
+"""
+
+from __future__ import annotations
+
+import getpass
+import hashlib
+import os
+import tempfile
+
+
+def machine_tag() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+    return hashlib.sha256(platform.processor().encode()).hexdigest()[:10]
+
+
+def enable_persistent_cache(min_compile_secs: float = 0.5) -> str:
+    """Point jax at the per-host cache dir; returns the path."""
+    import jax
+    path = os.path.join(
+        tempfile.gettempdir(),
+        f"lgbtpu_jax_cache_{getpass.getuser()}_{machine_tag()}")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path

@@ -23,38 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite's wall clock is dominated by
 # XLA compiles (hundreds of jit variants across growers / shapes), and
-# every run used to pay them from scratch.  min_compile_time 0.5 s keeps
-# tiny kernels out of it.  The cache lives in the MACHINE-LOCAL temp dir,
-# not the repo, AND is keyed by the host's CPU feature set: XLA:CPU AOT
-# entries are machine-feature-specific, and this environment can migrate
-# between heterogeneous hosts mid-session — a cache populated on one
-# host then read on another makes EVERY load fail ("Target machine
-# feature ... is not supported on the host machine"), paying both the
-# failed loads and the full recompiles (measured: a poisoned cache run
-# took 25 min where a fresh one compiles in far less).
-import getpass  # noqa: E402
-import hashlib  # noqa: E402
-import tempfile  # noqa: E402
+# every run used to pay them from scratch.  Machine-keyed (this
+# environment migrates between heterogeneous hosts and XLA:CPU AOT
+# entries are machine-specific) — see utils/compile_cache.py and
+# docs/Testing.md for the measured cost of getting this wrong.
+from lightgbm_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
 
-
-def _machine_tag() -> str:
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith("flags"):
-                    return hashlib.sha256(line.encode()).hexdigest()[:10]
-    except OSError:
-        pass
-    import platform
-    return hashlib.sha256(platform.processor().encode()).hexdigest()[:10]
-
-
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(tempfile.gettempdir(),
-                               f"lgbtpu_jax_cache_{getpass.getuser()}_"
-                               f"{_machine_tag()}"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+enable_persistent_cache()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

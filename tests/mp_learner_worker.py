@@ -34,9 +34,10 @@ def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    from lightgbm_tpu.utils.compile_cache import enable_persistent_cache
+    enable_persistent_cache()   # pods re-pay every compile without it
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from lightgbm_tpu.parallel import launch
 
