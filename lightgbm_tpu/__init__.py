@@ -10,6 +10,7 @@ reference's (`Dataset`, `Booster`, `train`, `cv`, sklearn wrappers).
 
 __version__ = "0.1.0"
 
+from .basic import LightGBMError
 from .binning import BinMapper, BinType, MissingType
 from .booster import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
@@ -17,9 +18,36 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .dataset import Dataset, Sequence
 from .engine import CVBooster, cv, train
+from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                       plot_split_value_histogram, plot_tree)
+from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+from .utils.log import register_logger
 
 __all__ = [
     "BinMapper", "BinType", "MissingType", "Booster", "Config", "CVBooster",
-    "Dataset", "EarlyStopException", "Sequence", "cv", "early_stopping",
-    "log_evaluation", "record_evaluation", "reset_parameter", "train",
+    "Dataset", "EarlyStopException", "LightGBMError", "Sequence", "cv",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "train",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+    "DaskLGBMRegressor", "DaskLGBMClassifier", "DaskLGBMRanker",
+    "register_logger",
+    "plot_importance", "plot_split_value_histogram", "plot_metric",
+    "plot_tree", "create_tree_digraph",
 ]
+
+_DASK_TO_DIST = {
+    "DaskLGBMRegressor": "DistributedLGBMRegressor",
+    "DaskLGBMClassifier": "DistributedLGBMClassifier",
+    "DaskLGBMRanker": "DistributedLGBMRanker",
+}
+
+
+def __getattr__(name: str):
+    # the reference exports Dask estimators from the top level; the
+    # Distributed* estimators are their analog here (distributed.py) and
+    # answer to BOTH spellings — resolved lazily so importing the
+    # package doesn't pay for the orchestration module
+    if name in _DASK_TO_DIST or name.startswith("DistributedLGBM"):
+        from . import distributed
+        return getattr(distributed, _DASK_TO_DIST.get(name, name))
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

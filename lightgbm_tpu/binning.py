@@ -73,6 +73,41 @@ def _forced_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     return uniq + [np.inf]
 
 
+def _zero_aware_find_bin(distinct: np.ndarray, counts: np.ndarray,
+                         max_bin: int, total_cnt: int,
+                         min_data_in_bin: int) -> np.ndarray:
+    """FindBinWithZeroAsOneBin (bin.cpp:256): the numeric axis is split
+    at zero — negative values bin with a budget proportional to their
+    share, the band (-kZeroThreshold, kZeroThreshold] is ALWAYS its own
+    bin whenever positive values exist (even with zero count 0: the
+    reference reserves it so unseen zeros at prediction time land in a
+    well-defined bin), and positives take the remaining budget.
+    ``distinct`` is sorted with near-zeros already collapsed to 0.0."""
+    left = distinct < 0.0
+    right = distinct > 0.0
+    cnt_zero = int(counts[(~left) & (~right)].sum())
+    left_cnt = int(counts[left].sum())
+    right_cnt = int(counts[right].sum())
+    bounds: List[float] = []
+    if left.any() and max_bin > 1:
+        denom = max(total_cnt - cnt_zero, 1)
+        left_max_bin = max(1, int(left_cnt / denom * (max_bin - 1)))
+        lb = _greedy_find_bin(distinct[left], counts[left], left_max_bin,
+                              left_cnt, min_data_in_bin)
+        if lb:
+            lb[-1] = -kZeroThreshold
+        bounds = list(lb)
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right.any() and right_max_bin > 0:
+        rb = _greedy_find_bin(distinct[right], counts[right],
+                              right_max_bin, right_cnt, min_data_in_bin)
+        bounds.append(kZeroThreshold)
+        bounds.extend(rb)
+    else:
+        bounds.append(np.inf)
+    return np.asarray(bounds, dtype=np.float64)
+
+
 def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                      max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
     """Greedy equal-count bin upper bounds over sorted distinct values.
@@ -119,8 +154,9 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
         # ~50 s in this loop; this form is milliseconds).  Output is
         # identical to the loop below.
         cum = np.cumsum(counts)
+        total = float(cum[-1])
         last = 0.0
-        for _ in range(max_bin - 1):
+        for closed in range(max_bin - 1):
             j = int(np.searchsorted(cum, last + mean_bin_size,
                                     side="left"))
             if j >= num_distinct - 1:
@@ -128,26 +164,38 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
             bounds.append((float(distinct_values[j])
                            + float(distinct_values[j + 1])) / 2.0)
             last = float(cum[j])
+            # adaptive mean (bin.cpp GreedyFindBin recomputes
+            # mean_bin_size from the REMAINING samples and bins after
+            # every close) — a fixed mean drifts high when early bins
+            # overshoot and silently loses tail bins
+            mean_bin_size = (total - last) / (max_bin - closed - 1)
         bounds.append(np.inf)
         return bounds
 
+    # mixed big/small values: the reference's sequential form with BOTH
+    # of its subtleties — a pending small bin closes early before a big
+    # value only once it holds >= half the mean, and the mean is
+    # recomputed from the REMAINING small samples/bins after every
+    # small-bin close (GreedyFindBin, bin.cpp:78)
     cur_cnt = 0
     bin_cnt = 0
-    for i in range(num_distinct):
+    rest_sample = rest_cnt
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample -= int(counts[i])
         cur_cnt += int(counts[i])
-        close = False
-        if is_big[i]:
-            close = True
-        elif cur_cnt >= mean_bin_size:
-            close = True
-        elif i + 1 < num_distinct and is_big[i + 1]:
-            close = True
-        if close and i + 1 < num_distinct:
+        close = (bool(is_big[i]) or cur_cnt >= mean_bin_size
+                 or (bool(is_big[i + 1])
+                     and cur_cnt >= max(1.0, mean_bin_size * 0.5)))
+        if close:
             bounds.append((float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
-            cur_cnt = 0
             bin_cnt += 1
             if bin_cnt >= max_bin - 1:
                 break
+            cur_cnt = 0
+            if not is_big[i]:
+                rest_bins -= 1
+                mean_bin_size = rest_sample / max(rest_bins, 1)
     bounds.append(np.inf)
     return bounds
 
@@ -222,8 +270,8 @@ class BinMapper:
             bounds = _forced_find_bin(distinct, counts, budget, total_non_na,
                                       min_data_in_bin, forced_bounds)
         else:
-            bounds = _greedy_find_bin(distinct, counts, budget, total_non_na,
-                                      min_data_in_bin)
+            bounds = _zero_aware_find_bin(distinct, counts, budget,
+                                          total_non_na, min_data_in_bin)
 
         # make sure zero sits alone in its bin boundary band when present
         # (FindBin carves [-kZeroThreshold, kZeroThreshold] out, bin.cpp)
@@ -231,11 +279,22 @@ class BinMapper:
         self.bin_upper_bound = ub
         self.num_bin = len(ub) + (1 if self.missing_type == MissingType.NAN else 0)
         self.is_trivial = self.num_bin <= 1
-        if min_split_data > 0 and pre_filter and len(distinct) > 0:
-            # feature_pre_filter analog: a feature that can never split is trivial
-            max_side = total_non_na - int(counts.min())
-            if len(distinct) == 1 or max_side < min_split_data:
-                pass
+        if not self.is_trivial and pre_filter and min_split_data > 0:
+            # NeedFilter (bin.cpp:54): a feature is useful only if SOME
+            # threshold puts >= min_split_data rows on both sides —
+            # e.g. a constant non-zero column has 2 bins (the reserved
+            # zero bin is empty) but can never split, so it is trivial
+            cnt_in_bin = np.zeros(len(ub), np.int64)
+            np.add.at(cnt_in_bin, np.searchsorted(ub, distinct,
+                                                  side="left"),
+                      counts.astype(np.int64))
+            if self.missing_type == MissingType.NAN:
+                cnt_in_bin = np.append(cnt_in_bin, na_cnt)
+            left = np.cumsum(cnt_in_bin[:-1])
+            total_all = int(cnt_in_bin.sum())
+            if not ((left >= min_split_data)
+                    & (total_all - left >= min_split_data)).any():
+                self.is_trivial = True
         # bin of literal zero / most frequent bin
         self.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
         if len(counts) > 0:

@@ -54,6 +54,15 @@ def _objective_from_string(s: str) -> Dict[str, Any]:
     return out
 
 
+class _IntAndCall(int):
+    """int that also answers the reference's METHOD spelling — basic.py
+    exposes ``bst.current_iteration()`` as a method while this framework
+    grew it as an attribute; a callable int serves both."""
+
+    def __call__(self) -> int:
+        return int(self)
+
+
 class Booster:
     """Training/prediction handle (basic.py:2548 / boosting.h:27 analog)."""
 
@@ -145,8 +154,26 @@ class Booster:
             if self._num_tree_per_iteration == 1:
                 preds = preds[:, 0]
             grad, hess = fobj(preds, self.train_set)
-            stopped = self._model.train_one_iter(np.asarray(grad),
-                                                 np.asarray(hess))
+            grad, hess = np.asarray(grad), np.asarray(hess)
+            n = self.train_set.num_data
+            k = self._num_tree_per_iteration
+            if grad.size != hess.size:
+                raise ValueError(
+                    f"Lengths of gradient ({grad.size}) and Hessian "
+                    f"({hess.size}) don't match")
+            if grad.size != n * k:
+                # reference-exact message shape (basic.py __boost)
+                raise ValueError(
+                    f"Lengths of gradient ({grad.size}) and Hessian "
+                    f"({hess.size}) don't match training data length "
+                    f"({n}) * number of models per one iteration ({k})")
+            if k > 1 and grad.ndim == 1:
+                # flat multiclass gradients arrive CLASS-major (the
+                # reference C convention, basic.py __boost F-ravel);
+                # internal layout is [n, k]
+                grad = grad.reshape(k, n).T
+                hess = hess.reshape(k, n).T
+            stopped = self._model.train_one_iter(grad, hess)
         else:
             stopped = self._model.train_one_iter()
         self._sync_trees()
@@ -177,10 +204,10 @@ class Booster:
         self.tree_weights = self._model.tree_weights
 
     @property
-    def current_iteration(self) -> int:
+    def current_iteration(self) -> "_IntAndCall":
         if self._model is not None:
-            return self._model.num_iterations_trained
-        return len(self.trees) // self._num_tree_per_iteration
+            return _IntAndCall(self._model.num_iterations_trained)
+        return _IntAndCall(len(self.trees) // self._num_tree_per_iteration)
 
     def num_trees(self) -> int:
         return len(self.trees)
@@ -208,8 +235,16 @@ class Booster:
         # would silently no-op — reject it instead of pretending
         allowed_now = {"learning_rate", "verbosity", "verbose",
                        "metric_freq", "feature_fraction",
-                       "feature_fraction_seed", "first_metric_only"}
+                       "feature_fraction_seed", "first_metric_only",
+                       # CEGB penalties are per-call grower inputs, so
+                       # resetting them only needs the state rebuilt
+                       # below (ResetConfig swaps the config the tree
+                       # learner reads, c_api.cpp ResetConfig)
+                       "cegb_tradeoff", "cegb_penalty_split",
+                       "cegb_penalty_feature_coupled",
+                       "cegb_penalty_feature_lazy"}
         from .config import _ALIASES, _coerce, _PARAMS
+        cegb_touched = False
         for k, v in params.items():
             canon = _ALIASES.get(k, k)
             if canon not in allowed_now:
@@ -218,6 +253,16 @@ class Booster:
                     "(requires dataset/grower reconstruction)")
             setattr(self._model.config, canon,
                     _coerce(canon, _PARAMS[canon][0], v))
+            # the saved model's parameters section serializes raw_params
+            self._model.config.raw_params[canon] = v
+            self.config.raw_params[canon] = v
+            cegb_touched = cegb_touched or canon.startswith("cegb_")
+        if cegb_touched:
+            if self._model._dist is not None:
+                raise ValueError(
+                    "CEGB is not supported with distributed learners")
+            self._model._cegb_state = self._model._make_cegb(
+                self._model.config, self._model.train_set)
         if "learning_rate" in params or "eta" in params \
                 or "shrinkage_rate" in params:
             self._model.learning_rate = float(
@@ -268,6 +313,22 @@ class Booster:
         Predictor analog).  ``pred_early_stop``: margin-based early exit
         across trees (prediction_early_stop.cpp:91)."""
         from .dataset import _is_scipy_sparse, _to_numpy_2d
+        if isinstance(data, (str, os.PathLike)):
+            # predict-from-file (the reference Predictor's text-input
+            # path, c_api.cpp LGBM_BoosterPredictForFile): CSV/TSV/
+            # LibSVM sniffed by the loader
+            from .data_io import load_text
+            data, _ = load_text(str(data))
+        if hasattr(data, "shape") and len(getattr(data, "shape", ())) == 2 \
+                and data.shape[1] != self._max_feature_idx + 1:
+            # checked BEFORE the chunked-sparse recursion and without a
+            # truthiness guard (a 1-feature model has _max_feature_idx
+            # == 0 — falsy, but the check must still fire)
+            from .basic import LightGBMError
+            raise LightGBMError(
+                f"The number of features in data ({data.shape[1]}) is "
+                f"not the same as it was in training data "
+                f"({self._max_feature_idx + 1}).")
         if _is_scipy_sparse(data) and data.shape[0] > 65536:
             # CSR prediction (LGBM_BoosterPredictForCSR analog): densify in
             # row chunks so peak memory stays bounded.
@@ -283,6 +344,12 @@ class Booster:
                       for i in range(0, data.shape[0], 65536)]
             return np.concatenate(chunks, axis=0)
         x, _, _ = _to_numpy_2d(data)
+        if x.shape[1] != self._max_feature_idx + 1:
+            from .basic import LightGBMError
+            raise LightGBMError(
+                f"The number of features in data ({x.shape[1]}) is not "
+                f"the same as it was in training data "
+                f"({self._max_feature_idx + 1}).")
         n = len(x)
         k = self._num_tree_per_iteration
         if num_iteration is None or num_iteration <= 0:

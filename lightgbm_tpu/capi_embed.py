@@ -760,7 +760,13 @@ def dataset_get_subset(ds, idx_mv, num: int, params: str):
 
 def dataset_add_features_from(target, source) -> None:
     """LGBM_DatasetAddFeaturesFrom (c_api.h:452): append source's
-    feature columns to target (Dataset.add_features_from)."""
+    feature columns to target (Dataset.add_features_from).  A C-API
+    dataset handle is semantically always constructed (the reference's
+    LGBM_DatasetCreateFromMat bins eagerly); only the PYTHON Dataset is
+    lazy, so construct before delegating — the lazy-API strictness
+    check is for python callers."""
+    _as_dataset(target).construct()
+    _as_dataset(source).construct()
     _as_dataset(target).add_features_from(_as_dataset(source))
 
 

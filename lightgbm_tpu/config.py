@@ -310,6 +310,9 @@ def _coerce(name: str, typ: type, value: Any) -> Any:
             return [_auto_num(tok) for tok in value.replace(";", ",").split(",") if tok != ""]
         if isinstance(value, (list, tuple)):
             return list(value)
+        if hasattr(value, "tolist"):      # ndarray / pandas
+            v = value.tolist()
+            return v if isinstance(v, list) else [v]
         return [value]
     if typ is str:
         return str(value)
@@ -398,7 +401,11 @@ class Config:
             self.metric = ["ndcg"]
         if self.objective in _MULTICLASS_OBJECTIVES and self.num_class <= 1:
             raise ValueError("num_class must be >1 for multiclass objectives")
-        if self.objective not in _MULTICLASS_OBJECTIVES and self.num_class != 1:
+        if self.objective not in _MULTICLASS_OBJECTIVES \
+                and self.num_class != 1 and self.objective != "custom":
+            # custom-objective training (objective=none) legitimately
+            # carries num_class>1: the caller's fobj produces per-class
+            # gradients (basic.py __boost F-ravels [n, num_class])
             raise ValueError("num_class can only be used with multiclass objectives")
         if self.bagging_freq > 0 and (self.bagging_fraction >= 1.0 and
                                       self.pos_bagging_fraction >= 1.0 and
@@ -420,7 +427,11 @@ class Config:
     # -- helpers -----------------------------------------------------------
     @property
     def num_model_per_iteration(self) -> int:
-        if self.objective in _MULTICLASS_OBJECTIVES:
+        if self.objective in _MULTICLASS_OBJECTIVES \
+                or (self.objective == "custom" and self.num_class > 1):
+            # custom-objective multiclass: num_class models per iter,
+            # gradients class-major from the caller (boosting.h
+            # num_model_per_iteration via num_class)
             return self.num_class
         return 1
 

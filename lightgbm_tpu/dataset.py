@@ -37,11 +37,21 @@ class Metadata:
         self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1]
         self.init_score: Optional[np.ndarray] = None
 
+    @staticmethod
+    def _avoid_inf(arr: np.ndarray, f32: bool = True) -> np.ndarray:
+        """Metadata fields sanitize NaN->0 and clamp +-inf to a large
+        finite value (Common::AvoidInf, common.h:658/670: 1e38 for
+        float fields, 1e300 for double) — the reference applies this on
+        every SetField so downstream math never sees non-finite
+        metadata."""
+        lim = 1e38 if f32 else 1e300
+        return np.nan_to_num(arr, nan=0.0, posinf=lim, neginf=-lim)
+
     def set_label(self, label) -> None:
         label = np.asarray(label, dtype=np.float32).reshape(-1)
         if len(label) != self.num_data:
             raise ValueError(f"label length {len(label)} != num_data {self.num_data}")
-        self.label = label
+        self.label = self._avoid_inf(label)
 
     def set_weight(self, weight) -> None:
         if weight is None:
@@ -50,6 +60,7 @@ class Metadata:
         weight = np.asarray(weight, dtype=np.float32).reshape(-1)
         if len(weight) != self.num_data:
             raise ValueError("weight length mismatch")
+        weight = self._avoid_inf(weight)
         if (weight < 0).any():
             raise ValueError("weights must be non-negative")
         self.weight = weight
@@ -70,7 +81,8 @@ class Metadata:
         if init_score is None:
             self.init_score = None
             return
-        s = np.asarray(init_score, dtype=np.float64)
+        s = self._avoid_inf(np.asarray(init_score, dtype=np.float64),
+                            f32=False)
         if s.size % self.num_data != 0:
             raise ValueError("init_score size must be num_data * num_class")
         self.init_score = s.reshape(self.num_data, -1) if s.ndim > 1 or s.size != self.num_data \
@@ -150,6 +162,12 @@ def _to_numpy_2d(data) -> tuple:
             else:
                 cols.append(col.to_numpy().astype(np.float64))
         arr = np.column_stack(cols) if cols else np.empty((len(data), 0))
+    elif (isinstance(data, (list, tuple)) and len(data)
+          and all(isinstance(c, np.ndarray) and c.ndim == 2
+                  for c in data)):
+        # list of 2-D row chunks (LGBM_DatasetCreateFromMats semantics —
+        # the reference's chunked-dataset path vstacks row blocks)
+        arr = np.vstack([np.asarray(c, np.float64) for c in data])
     else:
         arr = np.asarray(data, dtype=np.float64)
         if arr.ndim == 1:
@@ -185,6 +203,7 @@ class Dataset:
         self._preset_mappers = bin_mappers
 
         self._constructed = False
+        self.used_indices = None       # set by subset()
         # filled by construct():
         self.num_data: int = 0
         self.num_total_features: int = 0
@@ -314,6 +333,7 @@ class Dataset:
 
         self._bin_data(colfn, cfg, csc if sparse_in else None)
         keep_raw = (not self.free_raw_data) or bool(cfg.linear_tree)
+        self._built_linear_tree = bool(cfg.linear_tree)  # save_binary raw rule
         if sparse_in:
             if cfg.linear_tree and self.num_total_features:
                 # linear trees need dense raw values (dataset.h:836 raw_data_)
@@ -437,7 +457,10 @@ class Dataset:
             self.binned = out
         self.raw_data = None
         self._constructed = True
-        self._raw_input = None
+        if self.free_raw_data:
+            self._raw_input = None
+        # else: keep the Sequence list — get_data() returns it (basic.py
+        # keeps self.data = the sequences when free_raw_data=False)
         return self
 
     def _fit_bin_mappers(self, colfn, cfg: Config, cat_idx: set,
@@ -459,7 +482,11 @@ class Dataset:
             sample_col = sample_col_factory(np.arange(n, dtype=np.int64))
         else:
             sample_col = colfn
+        # may arrive as list OR ndarray (the reference accepts both;
+        # `if ndarray` would raise on truthiness)
         max_bin_by_feature = cfg.max_bin_by_feature
+        if max_bin_by_feature is not None and len(max_bin_by_feature) == 0:
+            max_bin_by_feature = None
         forced = {}
         if getattr(cfg, "forcedbins_filename", ""):
             # forced bin upper bounds (dataset_loader.cpp:519-524): JSON
@@ -472,10 +499,18 @@ class Dataset:
         self.bin_mappers = []
         for f in range(self.num_total_features):
             m = BinMapper()
-            mb = int(max_bin_by_feature[f]) if max_bin_by_feature else cfg.max_bin
+            mb = int(max_bin_by_feature[f]) if max_bin_by_feature is not None \
+                else cfg.max_bin
             bt = BinType.CATEGORICAL if f in cat_idx else BinType.NUMERICAL
             m.find_bin(sample_col(f), sample_cnt, mb, cfg.min_data_in_bin,
-                       min_split_data=cfg.min_data_in_leaf,
+                       # the reference scales the pre-filter threshold
+                       # to the SAMPLE (dataset_loader.cpp:687:
+                       # min_data_in_leaf * sample_size / num_data) —
+                       # num_data is the true row count, NOT the n the
+                       # streaming path passes (= its sample length)
+                       min_split_data=int(cfg.min_data_in_leaf
+                                          * sample_cnt
+                                          / max(self.num_data, 1)),
                        pre_filter=cfg.feature_pre_filter, bin_type=bt,
                        use_missing=cfg.use_missing, zero_as_missing=cfg.zero_as_missing,
                        forced_bounds=forced.get(f))
@@ -627,6 +662,12 @@ class Dataset:
             return self.raw_data
         if self._raw_input is not None:
             return self._raw_input
+        if self.used_indices is not None and self.reference is not None:
+            # subset of a Sequence-backed parent: gather rows lazily
+            # through the Sequence protocol only when actually asked
+            rows = self.reference._raw_rows(self.used_indices)
+            if rows is not None:
+                return rows
         raise ValueError(
             "raw data was freed: construct the Dataset with "
             "free_raw_data=False to keep it available")
@@ -694,9 +735,12 @@ class Dataset:
         return self
 
     def feature_num_bin(self, feature: int) -> int:
-        """Bin count of one feature (basic.py feature_num_bin)."""
+        """Bin count of one feature (basic.py feature_num_bin);
+        trivial/unused features report 0 like the reference's
+        LGBM_DatasetGetFeatureNumBin."""
         self.construct()
-        return int(self.bin_mappers[int(feature)].num_bin)
+        m = self.bin_mappers[int(feature)]
+        return 0 if m.is_trivial else int(m.num_bin)
 
     def get_ref_chain(self, ref_limit: int = 100):
         """The reference chain (basic.py get_ref_chain)."""
@@ -712,11 +756,18 @@ class Dataset:
     def add_features_from(self, other: "Dataset") -> "Dataset":
         """Append other's feature columns (Dataset::AddFeaturesFrom,
         LGBM_DatasetAddFeaturesFrom)."""
-        self.construct()
-        other.construct()
+        from .basic import LightGBMError
+        if not self._constructed or not other._constructed:
+            # reference semantics: both handles must exist (basic.py
+            # add_features_from raises before touching the C API)
+            raise LightGBMError(
+                "Both source and target Datasets must be constructed "
+                "before adding features")
         if self.num_data != other.num_data:
-            raise ValueError(
-                f"row mismatch: {self.num_data} vs {other.num_data}")
+            raise LightGBMError(
+                f"Cannot add features from other Dataset with a "
+                f"different number of rows ({other.num_data} vs "
+                f"{self.num_data})")
         nt = self.num_total_features
         self.binned = np.concatenate(
             [self.feature_binned(), other.feature_binned()], axis=1)
@@ -748,6 +799,101 @@ class Dataset:
         if self.metadata.query_boundaries is None:
             return None
         return np.diff(self.metadata.query_boundaries)
+
+    def get_feature_name(self):
+        self.construct()
+        return list(self.feature_names)
+
+    def _dump_text(self, path) -> "Dataset":
+        """Deterministic text dump of the constructed dataset
+        (LGBM_DatasetDumpText's debugging role, c_api.cpp DumpText):
+        names, per-feature bin bounds, and the binned rows — two
+        datasets with identical content dump identical text regardless
+        of HOW they were built (direct construct vs add_features_from),
+        which is exactly what the reference's add_features tests
+        compare."""
+        self.construct()
+        flat = self.feature_binned()
+        used = set(self.used_features)
+        with open(path, "w") as f:
+            f.write(f"num_data={self.num_data} "
+                    f"num_features={self.num_total_features}\n")
+            f.write("feature_names=" + ",".join(self.feature_names) + "\n")
+            col = 0
+            for j in range(self.num_total_features):
+                m = self.bin_mappers[j]
+                bounds = ",".join(f"{b:.17g}" for b in
+                                  np.asarray(m.bin_upper_bound).ravel()) \
+                    if m.bin_upper_bound is not None else ""
+                f.write(f"feature {j} used={j in used} "
+                        f"num_bin={int(m.num_bin)} bounds=[{bounds}]\n")
+            for i in range(self.num_data):
+                row = []
+                col = 0
+                for j in range(self.num_total_features):
+                    if j in used:
+                        row.append(str(int(flat[i, col])))
+                        col += 1
+                    else:
+                        row.append("-")
+                f.write(" ".join(row) + "\n")
+        return self
+
+    # -- reference attribute surface --------------------------------------
+    # basic.py keeps label/weight/init_score/group/feature_name as plain
+    # Dataset attributes refreshed from the C side on every set_field;
+    # here they are live views of the same state (metadata once
+    # constructed, the constructor inputs before), so
+    # ``ds.label``/``ds.get_label()``/``ds.get_field('label')`` always
+    # agree (test_basic.py::test_consistent_state_for_dataset_fields).
+    @property
+    def label(self):
+        return self.metadata.label if self.metadata is not None \
+            else self._label_in
+
+    @label.setter
+    def label(self, value):
+        self.set_label(value)
+
+    @property
+    def weight(self):
+        return self.metadata.weight if self.metadata is not None \
+            else self._weight_in
+
+    @weight.setter
+    def weight(self, value):
+        self.set_weight(value)
+
+    @property
+    def init_score(self):
+        return self.metadata.init_score if self.metadata is not None \
+            else self._init_score_in
+
+    @init_score.setter
+    def init_score(self, value):
+        self.set_init_score(value)
+
+    @property
+    def group(self):
+        if self.metadata is not None:
+            if self.metadata.query_boundaries is None:
+                return None
+            return np.diff(self.metadata.query_boundaries)
+        return self._group_in
+
+    @group.setter
+    def group(self, value):
+        self.set_group(value)
+
+    @property
+    def feature_name(self):
+        if getattr(self, "feature_names", None):
+            return list(self.feature_names)
+        return self._feature_name_in
+
+    @feature_name.setter
+    def feature_name(self, value):
+        self.set_feature_name(value)
 
     def set_label(self, label):
         if self.metadata is None:
@@ -783,17 +929,51 @@ class Dataset:
                        init_score=init_score, reference=self,
                        params=params or self.params)
 
+    def _raw_rows(self, idx: np.ndarray):
+        """Raw feature rows for ``idx``, from whichever raw source
+        survives: the kept ndarray/CSR, or the kept Sequence list
+        (gathered through the Sequence protocol)."""
+        if self.raw_data is not None:
+            return self.raw_data[idx]
+        src = self._raw_input
+        if src is None:
+            return None
+        if isinstance(src, Sequence) or (isinstance(src, (list, tuple))
+                                         and len(src)
+                                         and isinstance(src[0], Sequence)):
+            seqs = [src] if isinstance(src, Sequence) else list(src)
+            bounds = np.concatenate([[0], np.cumsum([len(s) for s in seqs])])
+            rows = []
+            for i in idx:
+                si = int(np.searchsorted(bounds, i, side="right") - 1)
+                rows.append(np.asarray(seqs[si][int(i - bounds[si])],
+                                       np.float64).reshape(-1))
+            return np.asarray(rows)
+        if hasattr(src, "shape"):
+            return np.asarray(src, np.float64)[idx]
+        return None
+
     def subset(self, used_indices, params=None) -> "Dataset":
-        """Row-subset copy (Dataset::CopySubrow, dataset.h:486 analog)."""
+        """Row-subset copy (Dataset::CopySubrow, dataset.h:486 analog).
+        Indices are SORTED like the reference python subset (basic.py
+        used_indices sort) — rows keep their original relative order."""
         self.construct()
-        idx = np.asarray(used_indices, dtype=np.int64)
+        idx = np.sort(np.asarray(used_indices, dtype=np.int64))
         sub = Dataset.__new__(Dataset)
         sub.__dict__.update({k: v for k, v in self.__dict__.items()})
         sub.num_data = len(idx)
         sub.binned = self.binned[idx] if self.binned is not None else None
         sub.binned_sparse = self.binned_sparse.subset_rows(idx) \
             if self.binned_sparse is not None else None
-        sub.raw_data = self.raw_data[idx] if self.raw_data is not None else None
+        # raw rows slice cheaply when the parent holds them in memory;
+        # a Sequence-backed parent stays LAZY (get_data gathers through
+        # the protocol on demand via used_indices + reference) — eager
+        # gathering here would materialize dense row blocks for every
+        # cv fold of an out-of-core dataset
+        sub.raw_data = self.raw_data[idx] if self.raw_data is not None \
+            else None
+        sub._raw_input = None
+        sub.used_indices = idx
         sub.metadata = Metadata(len(idx))
         if self.metadata.label is not None:
             sub.metadata.label = self.metadata.label[idx]
@@ -801,6 +981,13 @@ class Dataset:
             sub.metadata.weight = self.metadata.weight[idx]
         if self.metadata.init_score is not None:
             sub.metadata.init_score = self.metadata.init_score[idx]
+        if self.metadata.query_boundaries is not None:
+            # per-query counts of the selected rows, empty queries
+            # dropped — partial queries shrink (Metadata::CopySubrow's
+            # query handling; sorted idx keeps rows query-contiguous)
+            qb = self.metadata.query_boundaries
+            qidx = np.searchsorted(qb, idx, side="right") - 1
+            sub.metadata.set_group(np.unique(qidx, return_counts=True)[1])
         sub.reference = self
         return sub
 
@@ -846,14 +1033,24 @@ class Dataset:
             payload["query_boundaries"] = self.metadata.query_boundaries
         if self.metadata.init_score is not None:
             payload["init_score"] = self.metadata.init_score
-        if isinstance(self.raw_data, np.ndarray):
-            payload["raw_data"] = self.raw_data
-        elif self.raw_data is not None and hasattr(self.raw_data, "tocsr"):
-            csr = self.raw_data.tocsr()
-            payload["raw_csr_data"] = csr.data
-            payload["raw_csr_indices"] = csr.indices
-            payload["raw_csr_indptr"] = csr.indptr
-            payload["raw_csr_shape"] = np.asarray(csr.shape, np.int64)
+        # raw feature values are in the binary ONLY for linear-tree
+        # datasets (the reference's SaveBinaryFile keeps raw values iff
+        # has_raw_, i.e. linear_tree — a loaded dataset must still fit
+        # linear leaves).  Otherwise the file stores just the binned
+        # representation + metadata, making it a pure function of
+        # dataset CONTENT: an ndarray-built and a Sequence-built
+        # dataset with identical bins produce identical binaries
+        # (test_basic.py::test_sequence's filecmp contract).
+        if getattr(self, "_built_linear_tree", False) \
+                and self.raw_data is not None:
+            if isinstance(self.raw_data, np.ndarray):
+                payload["raw_data"] = self.raw_data
+            elif hasattr(self.raw_data, "tocsr"):
+                csr = self.raw_data.tocsr()
+                payload["raw_csr_data"] = csr.data
+                payload["raw_csr_indices"] = csr.indices
+                payload["raw_csr_indptr"] = csr.indptr
+                payload["raw_csr_shape"] = np.asarray(csr.shape, np.int64)
         if self.efb is not None:
             payload["efb_group_of_feat"] = self.efb.group_of_feat
             payload["efb_off_of_feat"] = self.efb.off_of_feat

@@ -274,12 +274,12 @@ def _fit_worker(ctx: WorkerContext, args: dict, part: tuple):
     k_sample = int(p.get("bin_construct_sample_cnt", 200000))
     if _is_sparse(x):
         x = x.tocsr()
-        # densifying the sample is bounded by an element budget, not
-        # just a row count — wide-sparse input (the k-hot storage's
-        # whole reason to exist) would otherwise materialize
-        # rows x FULL-width float64 here
+        # densifying the sample is bounded by an ELEMENT budget — the
+        # floor is 1 row, not a fixed row count, or the budget would be
+        # defeated exactly on the very-wide input it exists for
+        # (256 rows x 5M columns is already ~10 GB dense)
         k_sample = min(k_sample,
-                       max(256, 50_000_000 // max(1, x.shape[1])))
+                       max(1, 50_000_000 // max(1, x.shape[1])))
         sample = x[:k_sample].toarray()
     else:
         sample = np.asarray(x)[:k_sample]
@@ -533,6 +533,8 @@ def _main(argv: List[str]) -> None:
         import jax
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        from .utils.compile_cache import enable_persistent_cache
+        enable_persistent_cache()
 
     from .parallel import launch
     entries = [m for m in ns.machines.split(",") if m]

