@@ -497,8 +497,11 @@ class Booster:
         return self
 
     def dump_model(self, num_iteration: Optional[int] = None,
-                   start_iteration: int = 0) -> Dict[str, Any]:
-        """JSON model dump (GBDT::DumpModel, gbdt_model_text.cpp:21)."""
+                   start_iteration: int = 0,
+                   object_hook=None) -> Dict[str, Any]:
+        """JSON model dump (GBDT::DumpModel, gbdt_model_text.cpp:21).
+        ``object_hook`` is applied to every JSON object exactly like the
+        reference (basic.py dump_model json.loads object_hook)."""
         k = self._num_tree_per_iteration
         t0 = start_iteration * k
         t1 = len(self.trees) if num_iteration is None else \
@@ -542,7 +545,7 @@ class Booster:
                 "shrinkage": float(t.shrinkage),
                 "tree_structure": node_json(t, 0 if t.num_leaves > 1 else -1),
             })
-        return {
+        out = {
             "name": "tree",
             "version": "v3",
             "num_class": self._num_class,
@@ -559,6 +562,10 @@ class Booster:
                 for f, v in enumerate(self.feature_importance("gain")) if v > 0},
             "tree_info": trees,
         }
+        if object_hook is not None:
+            import json as _json
+            out = _json.loads(_json.dumps(out), object_hook=object_hook)
+        return out
 
     # -- python-package convenience surface (basic.py parity) ----------
     def attr(self, key: str):
@@ -669,6 +676,12 @@ class Booster:
                         if self.feature_names else int(t.split_feature[n])),
                     "split_gain": float(t.split_gain[n]),
                     "threshold": float(t.threshold[n]),
+                    "decision_type": "==" if (t.decision_type[n] & 1)
+                    else "<=",
+                    "missing_direction": "left"
+                    if (t.decision_type[n] & 2) else "right",
+                    "missing_type": ["None", "Zero", "NaN"][
+                        (int(t.decision_type[n]) >> 2) & 3],
                     "value": float(t.internal_value[n]),
                     "weight": float(t.internal_weight[n]),
                     "count": int(t.internal_count[n]),
@@ -681,10 +694,15 @@ class Booster:
                     "left_child": None, "right_child": None,
                     "parent_index": parents.get(~leaf),
                     "split_feature": None, "split_gain": None,
-                    "threshold": None,
+                    "threshold": None, "decision_type": None,
+                    "missing_direction": None, "missing_type": None,
                     "value": float(t.leaf_value[leaf]),
-                    "weight": float(t.leaf_weight[leaf]),
-                    "count": int(t.leaf_count[leaf]),
+                    # a stump records no weight/count (the reference's
+                    # single-leaf tree_structure carries only the value)
+                    "weight": float(t.leaf_weight[leaf])
+                    if t.num_nodes() else None,
+                    "count": int(t.leaf_count[leaf])
+                    if t.num_nodes() else None,
                 })
         return pd.DataFrame(rows)
 

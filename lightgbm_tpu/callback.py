@@ -23,16 +23,23 @@ class EarlyStopException(Exception):
         self.best_score = best_score
 
 
-def _fmt_eval(res) -> str:
-    name, metric, value, _ = res
-    return f"{name}'s {metric}: {value:g}"
+def _fmt_eval(res, show_stdv: bool = True) -> str:
+    if len(res) == 4:
+        name, metric, value, _ = res
+        return f"{name}'s {metric}: {value:g}"
+    # cv 5-tuple (callback.py _format_eval_result cv branch)
+    _, key, mean, _hib, stdv = res
+    if show_stdv:
+        return f"cv_agg's {key}: {mean:g} + {stdv:g}"
+    return f"cv_agg's {key}: {mean:g}"
 
 
 def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
     def _callback(env: CallbackEnv) -> None:
         if period > 0 and env.evaluation_result_list \
                 and (env.iteration + 1) % period == 0:
-            msg = "\t".join(_fmt_eval(r) for r in env.evaluation_result_list)
+            msg = "\t".join(_fmt_eval(r, show_stdv)
+                            for r in env.evaluation_result_list)
             print(f"[{env.iteration + 1}]\t{msg}")
     _callback.order = 10
     return _callback
@@ -43,9 +50,21 @@ def record_evaluation(eval_result: Dict) -> Callable:
         raise TypeError("eval_result must be a dict")
 
     def _callback(env: CallbackEnv) -> None:
-        for name, metric, value, _ in env.evaluation_result_list:
-            eval_result.setdefault(name, collections.OrderedDict())
-            eval_result[name].setdefault(metric, []).append(value)
+        for item in env.evaluation_result_list:
+            if len(item) == 4:
+                name, metric, value = item[0], item[1], item[2]
+                eval_result.setdefault(name, collections.OrderedDict())
+                eval_result[name].setdefault(metric, []).append(value)
+            else:
+                # cv 5-tuple ('cv_agg', '<set> <metric>', mean, hib,
+                # stdv) — recorded as {set: {metric-mean: [...],
+                # metric-stdv: [...]}} (reference callback.py:111-136)
+                dsname, metric = item[1].split(" ", 1)
+                eval_result.setdefault(dsname, collections.OrderedDict())
+                eval_result[dsname].setdefault(f"{metric}-mean",
+                                               []).append(item[2])
+                eval_result[dsname].setdefault(f"{metric}-stdv",
+                                               []).append(item[4])
     _callback.order = 20
     return _callback
 
@@ -56,12 +75,17 @@ def reset_parameter(**kwargs) -> Callable:
 
     def _callback(env: CallbackEnv) -> None:
         it = env.iteration - env.begin_iteration
+        # cv passes the CVBooster container — the schedule applies to
+        # every fold (the reference's _reset_parameter_callback does the
+        # same CVBooster fan-out)
+        boosters = getattr(env.model, "boosters", None) or [env.model]
         for key, value in kwargs.items():
             new_val = value[it] if isinstance(value, list) else value(it)
-            if key == "learning_rate":
-                env.model._model.learning_rate = new_val
-            else:
-                setattr(env.model._model.config, key, new_val)
+            for bst in boosters:
+                if key == "learning_rate":
+                    bst._model.learning_rate = new_val
+                else:
+                    setattr(bst._model.config, key, new_val)
     _callback.before_iteration = True
     _callback.order = 10
     return _callback
@@ -76,36 +100,71 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     enabled = [True]
     first_metric = [""]
 
+    def _metric_of(item) -> str:
+        # cv 5-tuples carry '<set> <metric>' as the key
+        m = item[1]
+        return m.split(" ", 1)[1] if item[0] == "cv_agg" and " " in m else m
+
     def _init(env: CallbackEnv) -> None:
         enabled[0] = bool(env.evaluation_result_list)
         if not enabled[0]:
             return
         best_score.clear(), best_iter.clear()
         best_score_list.clear(), cmp_op.clear()
-        first_metric[0] = env.evaluation_result_list[0][1].split("@")[0]
-        for (_name, _metric, _val, higher_better) in env.evaluation_result_list:
+        first_metric[0] = _metric_of(
+            env.evaluation_result_list[0]).split("@")[0]
+        # per-metric deltas (callback.py _EarlyStoppingCallback): a list
+        # gives one delta per UNIQUE metric (broadcast over datasets),
+        # a scalar applies everywhere; negatives are rejected
+        uniq = []
+        for item in env.evaluation_result_list:
+            m = _metric_of(item)
+            if m not in uniq:
+                uniq.append(m)
+        if isinstance(min_delta, (list, tuple)):
+            deltas = [float(d) for d in min_delta]
+            if any(d < 0 for d in deltas):
+                raise ValueError("Values for early stopping min_delta "
+                                 "must be non-negative.")
+            if len(deltas) != len(uniq):
+                raise ValueError("Must provide a single value for "
+                                 "min_delta or as many as metrics.")
+            delta_of = dict(zip(uniq, deltas))
+        else:
+            if float(min_delta) < 0:
+                raise ValueError("Early stopping min_delta must be "
+                                 "non-negative.")
+            delta_of = {m: float(min_delta) for m in uniq}
+        for item in env.evaluation_result_list:
+            higher_better = item[3]
+            d = delta_of[_metric_of(item)]
             best_iter.append(0)
             best_score_list.append(None)
             if higher_better:
                 best_score.append(float("-inf"))
-                cmp_op.append(lambda new, best: new > best + min_delta)
+                cmp_op.append(
+                    lambda new, best, _d=d: new > best + _d)
             else:
                 best_score.append(float("inf"))
-                cmp_op.append(lambda new, best: new < best - min_delta)
+                cmp_op.append(
+                    lambda new, best, _d=d: new < best - _d)
 
     def _callback(env: CallbackEnv) -> None:
         if not best_score:
             _init(env)
         if not enabled[0]:
             return
-        for i, (name, metric, val, _hib) in enumerate(env.evaluation_result_list):
+        for i, item in enumerate(env.evaluation_result_list):
+            name, val = item[0], item[2]
+            metric = _metric_of(item)
             if best_score_list[i] is None or cmp_op[i](val, best_score[i]):
                 best_score[i] = val
                 best_iter[i] = env.iteration
                 best_score_list[i] = list(env.evaluation_result_list)
             if first_metric_only and metric.split("@")[0] != first_metric[0]:
                 continue
-            if name == "training":
+            if name == "training" \
+                    or (name == "cv_agg" and item[1].startswith("train ")):
                 continue
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:

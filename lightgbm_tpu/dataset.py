@@ -553,6 +553,14 @@ class Dataset:
     def _finalize_mappers(self) -> None:
         self.used_features = [f for f in range(self.num_total_features)
                               if not self.bin_mappers[f].is_trivial]
+        if not self.used_features and self.num_total_features > 0:
+            # ALL features trivial (constant data): keep one
+            # unsplittable placeholder column so training degrades to
+            # stump trees — predictions become the boosted average,
+            # matching the reference, which happily trains on constant
+            # data (test_engine.py check_constant_features) instead of
+            # erroring out
+            self.used_features = [0]
         nbins = [self.bin_mappers[f].num_bin for f in self.used_features]
         self.bin_offsets = np.concatenate([[0], np.cumsum(nbins)]).astype(np.int32)
         self.max_bin = max([2] + nbins)

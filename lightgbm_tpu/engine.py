@@ -27,14 +27,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
           valid_names: Optional[List[str]] = None,
           feval: Optional[Callable] = None,
           init_model: Optional[Union[str, Booster]] = None,
+          feature_name="auto", categorical_feature="auto",
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
           fobj: Optional[Callable] = None) -> Booster:
     """Train a gradient-boosted model (engine.py:25 analog)."""
     params = dict(params or {})
     cfg = Config(params)
-    if cfg.num_iterations != 100 and num_boost_round == 100:
+    from .config import canonical_params
+    if "num_iterations" in canonical_params(params):
+        # any num_iterations alias in params overrides the keyword
+        # unconditionally (reference train pops the alias and wins)
         num_boost_round = cfg.num_iterations
+    if valid_sets is not None and not isinstance(valid_sets, (list, tuple)):
+        valid_sets = [valid_sets]       # reference accepts a bare Dataset
+    if isinstance(valid_names, str):
+        valid_names = [valid_names]
+    if feature_name != "auto" and not train_set._constructed:
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto" and not train_set._constructed:
+        train_set.set_categorical_feature(categorical_feature)
 
     # continued training: init_model predictions become the init score
     # (application.cpp:88-94 input_model pattern)
@@ -46,10 +58,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
         train_set.set_init_score(np.asarray(raw, np.float64))
 
     booster = Booster(params=params, train_set=train_set)
+    train_eval_name = None
     if valid_sets:
-        names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+        names = valid_names or [
+            "training" if vs is train_set else f"valid_{i}"
+            for i, vs in enumerate(valid_sets)]
         for vs, name in zip(valid_sets, names):
             if vs is train_set:
+                # reference semantics: the training set in valid_sets
+                # means "report training metrics under this name"
+                # (engine.py train: name_valid_sets / 'training')
+                train_eval_name = name
+                booster._train_data_name = name
                 continue
             booster.add_valid(vs, name)
 
@@ -80,6 +100,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if (chunk > 1 and fobj is None and not cbs
             and not booster._valid_names
             and not cfg.is_provide_training_metric
+            and train_eval_name is None
             and cfg.snapshot_freq <= 0 and cfg.verbosity <= 1
             and booster.supports_fused()):
         while num_boost_round - start_round >= chunk and not chunk_stopped:
@@ -101,8 +122,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # periodic snapshot (gbdt.cpp:279-284 snapshot_freq)
             booster.save_model(f"{cfg.output_model}.snapshot_iter_{i + 1}")
         evals = []
-        if booster._valid_names or cfg.is_provide_training_metric:
-            if cfg.is_provide_training_metric:
+        if booster._valid_names or cfg.is_provide_training_metric \
+                or train_eval_name is not None:
+            if cfg.is_provide_training_metric or train_eval_name is not None:
                 evals.extend(booster.eval_train(feval))
             evals.extend(booster.eval_valid(feval))
         env = CallbackEnv(model=booster, params=params, iteration=i,
@@ -159,19 +181,15 @@ def _make_folds(ds: Dataset, nfold: int, stratified: bool, shuffle: bool,
     n = ds.num_data
     rng = np.random.RandomState(seed)
     if ds.metadata.query_boundaries is not None:
-        # group-aware folds (engine.py _make_n_folds group handling)
+        # group-aware folds: the reference delegates to sklearn's
+        # GroupKFold for ranking cv (engine.py _make_n_folds uses
+        # _LGBMGroupKFold), so a user passing folds=GroupKFold(n) gets
+        # IDENTICAL splits to nfold=n — keep that equivalence
         sizes = np.diff(ds.metadata.query_boundaries)
-        q = len(sizes)
-        order = rng.permutation(q) if shuffle else np.arange(q)
-        folds_q = np.array_split(order, nfold)
-        starts = ds.metadata.query_boundaries[:-1]
-        for fq in folds_q:
-            test_rows = np.concatenate([
-                np.arange(starts[qi], starts[qi] + sizes[qi]) for qi in fq]) \
-                if len(fq) else np.array([], np.int64)
-            mask = np.zeros(n, bool)
-            mask[test_rows] = True
-            yield np.nonzero(~mask)[0], np.nonzero(mask)[0]
+        groups = np.repeat(np.arange(len(sizes)), sizes)
+        from sklearn.model_selection import GroupKFold
+        yield from GroupKFold(n_splits=nfold).split(
+            np.empty((n, 1)), groups=groups)
         return
     if stratified and cfg.objective in ("binary", "multiclass", "multiclassova"):
         label = np.asarray(ds.metadata.label).astype(np.int64)
@@ -197,16 +215,31 @@ def _make_folds(ds: Dataset, nfold: int, stratified: bool, shuffle: bool,
 
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
-       metrics=None, feval=None, init_model=None,
-       seed: int = 0, callbacks=None, eval_train_metric: bool = False,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       fpreproc=None, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
-    """K-fold cross-validation (engine.py:375 analog)."""
+    """K-fold cross-validation (engine.py:375 analog).
+
+    fpreproc: ``f(fold_train, fold_valid, params) -> (train, valid,
+    params)`` applied per fold before training (the reference's
+    preprocessing hook).  eval_train_metric adds ``train <metric>-mean``
+    series alongside the ``valid`` ones.
+    """
     params = dict(params or {})
     if metrics is not None:
         params["metric"] = metrics
+    from .config import canonical_params
+    if "num_iterations" in canonical_params(params):
+        # params win unconditionally, like train() (reference pops the
+        # alias in both entry points)
+        num_boost_round = Config(params).num_iterations
+    if feature_name != "auto" and not train_set._constructed:
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto" and not train_set._constructed:
+        train_set.set_categorical_feature(categorical_feature)
     cfg = Config(params)
-    if cfg.num_iterations != 100 and num_boost_round == 100:
-        num_boost_round = cfg.num_iterations
     if not train_set._constructed and train_set.params:
         # dataset's own params are the binning base, cv params override
         # (reference _update_params semantics — see Booster.__init__)
@@ -217,36 +250,85 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
 
     if folds is None:
         folds = list(_make_folds(train_set, nfold, stratified, shuffle, seed, cfg))
+    elif hasattr(folds, "split"):
+        # scikit-learn splitter object (reference cv accepts these):
+        # split over row indices, group-aware when the splitter wants it
+        lbl = train_set.get_label()
+        g = train_set.get_group()
+        groups = np.repeat(np.arange(len(g)), g) if g is not None else None
+        folds = list(folds.split(np.empty((train_set.num_data, 1)),
+                                 y=lbl, groups=groups))
 
     cvbooster = CVBooster()
     results = collections.defaultdict(list)
-    fold_results: List[Dict[str, List[float]]] = []
-    group = train_set.get_group()
     for (tr_idx, te_idx) in folds:
+        # subset() reconstructs per-fold query groups from the parent's
+        # boundaries itself
         tr = train_set.subset(tr_idx)
         te = train_set.subset(te_idx)
-        if group is not None:
-            # rebuild per-fold group sizes from query boundaries
-            tr._group_from_parent(train_set, tr_idx)
-            te._group_from_parent(train_set, te_idx)
-        rec: Dict[str, Any] = {}
-        cb = list(callbacks or []) + [callback_mod.record_evaluation(rec)]
-        bst = train(params, tr, num_boost_round, valid_sets=[te],
-                    valid_names=["valid"], feval=feval, callbacks=cb)
+        fold_params = params
+        if fpreproc is not None:
+            tr, te, fold_params = fpreproc(tr, te, dict(params))
+        bst = Booster(params=dict(fold_params), train_set=tr)
+        bst._train_data_name = "train"
+        bst.add_valid(te, "valid")
         cvbooster.append(bst)
-        fold_results.append(rec.get("valid", {}))
 
-    # aggregate mean/std per metric per iteration
-    if fold_results:
-        metric_names = fold_results[0].keys()
-        for mname in metric_names:
-            series = [fr[mname] for fr in fold_results if mname in fr]
-            rounds = min(len(s) for s in series)
-            arr = np.asarray([s[:rounds] for s in series])
-            results[f"valid {mname}-mean"] = list(arr.mean(axis=0))
-            results[f"valid {mname}-stdv"] = list(arr.std(axis=0))
+    # lockstep boosting (the reference's CVBooster: every fold advances
+    # one iteration, then the AGGREGATED metrics go to the callbacks as
+    # ('cv_agg', '<set> <metric>', mean, higher_better, stdv) 5-tuples —
+    # which is what gives cv early stopping and cv record_evaluation
+    # their reference semantics)
+    cbs = list(callbacks or [])
+    cfg2 = Config(params)
+    if cfg2.early_stopping_round and cfg2.early_stopping_round > 0:
+        cbs.append(callback_mod.early_stopping(
+            cfg2.early_stopping_round, cfg2.first_metric_only,
+            cfg2.verbosity > 0))
+    cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
+    cbs_after = [c for c in cbs if not getattr(c, "before_iteration", False)]
+    cbs_before.sort(key=lambda c: getattr(c, "order", 0))
+    cbs_after.sort(key=lambda c: getattr(c, "order", 0))
+    best_iter = -1      # stays -1 unless early stopping fires (reference)
+    for i in range(num_boost_round):
+        env = CallbackEnv(model=cvbooster, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=None)
+        for cb in cbs_before:
+            cb(env)
+        per_key: Dict[str, list] = collections.OrderedDict()
+        hib_of: Dict[str, bool] = {}
+        for bst in cvbooster.boosters:
+            bst.update(fobj=fobj)
+            one = list(bst.eval_train(feval)) if eval_train_metric else []
+            one.extend(bst.eval_valid(feval))
+            for (nm, met, val, hib) in one:
+                key = f"{nm} {met}"
+                per_key.setdefault(key, []).append(val)
+                hib_of[key] = hib
+        agg = [("cv_agg", k, float(np.mean(v)), hib_of[k], float(np.std(v)))
+               for k, v in per_key.items()]
+        for (_, k, mean, _h, std) in agg:
+            results[f"{k}-mean"].append(mean)
+            results[f"{k}-stdv"].append(std)
+        env = CallbackEnv(model=cvbooster, params=params, iteration=i,
+                          begin_iteration=0, end_iteration=num_boost_round,
+                          evaluation_result_list=agg)
+        try:
+            for cb in cbs_after:
+                cb(env)
+        except EarlyStopException as e:
+            best_iter = e.best_iteration + 1
+            for b in cvbooster.boosters:
+                b.best_iteration = best_iter
+            # the reference trims the history to the best iteration
+            for k in results:
+                results[k] = results[k][:best_iter]
+            break
     out = dict(results)
     if return_cvbooster:
-        cvbooster.best_iteration = max(b.best_iteration for b in cvbooster.boosters)
+        cvbooster.best_iteration = best_iter
         out["cvbooster"] = cvbooster
     return out
+
+

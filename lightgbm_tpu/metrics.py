@@ -242,7 +242,10 @@ class MultiErrorMetric(Metric):
         true_score = score[np.arange(len(idx)), idx]
         rank = (score >= true_score[:, None]).sum(axis=1)
         err = (rank > k).astype(np.float64)
-        return [(self.name, self._avg(err), False)]
+        # top-k > 1 reports as multi_error@k (multiclass_metric.hpp
+        # MultiErrorMetric::Name)
+        name = self.name if k <= 1 else f"{self.name}@{k}"
+        return [(name, self._avg(err), False)]
 
 
 class AucMuMetric(Metric):

@@ -254,9 +254,10 @@ class BinaryLogloss(ObjectiveFunction):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        lbl = np.asarray(metadata.label)
-        if not np.isin(np.unique(lbl), [0.0, 1.0]).all():
-            raise ValueError("binary objective requires labels in {0, 1}")
+        # reference positivity rule (binary_objective.hpp:37 is_pos_):
+        # label > 0 is positive — {0, 10} labels train like {0, 1}
+        lbl = (np.asarray(metadata.label) > 0).astype(np.float64)
+        self.label = jnp.asarray(lbl, jnp.float32)
         cnt_pos = float(lbl.sum()) if metadata.weight is None else \
             float((lbl * metadata.weight).sum())
         cnt_neg = (float(len(lbl) - lbl.sum()) if metadata.weight is None else
@@ -325,6 +326,17 @@ class MulticlassSoftmax(ObjectiveFunction):
             return grad * self.weight[:, None], hess * self.weight[:, None]
         return grad, hess
 
+    def boost_from_score(self, class_id=0):
+        # log class prior (multiclass_objective.hpp:155
+        # class_init_probs_) — softmax of the init scores reproduces
+        # the empirical class distribution
+        oh = np.asarray(self.onehot)
+        w = np.asarray(self.weight)[:, None] if self.weight is not None \
+            else 1.0
+        probs = (oh * w).sum(axis=0)
+        probs = probs / max(probs.sum(), 1e-15)
+        return float(np.log(max(1e-15, probs[class_id])))
+
     def convert_output(self, raw):
         return jax.nn.softmax(raw, axis=-1)
 
@@ -353,6 +365,18 @@ class MulticlassOVA(ObjectiveFunction):
         if self.weight is not None:
             return grad * self.weight[:, None], hess * self.weight[:, None]
         return grad, hess
+
+    def boost_from_score(self, class_id=0):
+        # per-class binary boost (multiclass_objective.hpp:261 delegates
+        # to the underlying binary losses)
+        oh = np.asarray(self.onehot)
+        w = np.asarray(self.weight) if self.weight is not None \
+            else np.ones(len(oh))
+        pos = float((oh[:, class_id] * w).sum())
+        p = pos / max(float(w.sum()), 1e-15)
+        if p <= 0.0 or p >= 1.0:
+            return 0.0
+        return float(np.log(p / (1.0 - p)) / self.sigmoid)
 
     def convert_output(self, raw):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * raw))
