@@ -212,6 +212,28 @@ class Booster:
     def num_trees(self) -> int:
         return len(self.trees)
 
+    # -- pickling / copying (basic.py __getstate__: a Booster serializes
+    #    as its model string — the live training state holds jitted
+    #    device programs that cannot and should not be pickled) --------
+    def __getstate__(self):
+        return {"model_str": self.model_to_string(),
+                "best_iteration": int(self.best_iteration),
+                "best_score": dict(self.best_score)}
+
+    def __setstate__(self, state):
+        self.__init__(model_str=state["model_str"])
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _memo):
+        new = Booster(model_str=self.model_to_string())
+        new.best_iteration = self.best_iteration
+        new.best_score = dict(self.best_score)
+        return new
+
     def num_model_per_iteration(self) -> int:
         return self._num_tree_per_iteration
 
@@ -352,10 +374,18 @@ class Booster:
                 f"({self._max_feature_idx + 1}).")
         n = len(x)
         k = self._num_tree_per_iteration
-        if num_iteration is None or num_iteration <= 0:
+        start_iteration = max(0, start_iteration)
+        if num_iteration is None:
+            # only an OMITTED num_iteration defaults to the best
+            # iteration, and only from the start; an explicit <= 0 means
+            # all trees (basic.py predict contract: None -> best, the C
+            # side treats non-positive as unbounded)
             num_iteration = (self.best_iteration
-                             if self.best_iteration > 0 else
+                             if self.best_iteration > 0
+                             and start_iteration <= 0 else
                              len(self.trees) // k)
+        elif num_iteration <= 0:
+            num_iteration = len(self.trees) // k
         t0, t1 = start_iteration * k, min((start_iteration + num_iteration) * k,
                                           len(self.trees))
         if pred_leaf:
@@ -948,6 +978,3 @@ class Booster:
     @classmethod
     def model_from_string(cls, model_str: str) -> "Booster":
         return cls(model_str=model_str)
-
-    def __deepcopy__(self, memo):
-        return Booster(model_str=self.model_to_string())

@@ -39,6 +39,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # any num_iterations alias in params overrides the keyword
         # unconditionally (reference train pops the alias and wins)
         num_boost_round = cfg.num_iterations
+    # ...and the effective round count is written back so the saved
+    # model's parameters section records it (reference train sets
+    # params["num_iterations"] = num_boost_round)
+    params["num_iterations"] = num_boost_round
     if valid_sets is not None and not isinstance(valid_sets, (list, tuple)):
         valid_sets = [valid_sets]       # reference accepts a bare Dataset
     if isinstance(valid_names, str):

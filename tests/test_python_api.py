@@ -285,3 +285,46 @@ def test_dataset_params_are_binning_base():
                     "verbosity": -1}, ds2, num_boost_round=2)
     nb = max(m.num_bin for m in b2.train_set.bin_mappers)
     assert 16 < nb <= 32, nb
+
+
+def test_predict_start_iteration_slices_sum():
+    """predict(start_iteration, num_iteration) slices must sum to the
+    full raw prediction (basic.py contract; the reference's own test of
+    this couples it to an early-stopping trajectory)."""
+    x, y = _data(seed=13)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(x, label=y),
+                    num_boost_round=20)
+    full = bst.predict(x, raw_score=True)
+    sliced = sum(bst.predict(x, start_iteration=s, num_iteration=7,
+                             raw_score=True) for s in range(0, 20, 7))
+    np.testing.assert_allclose(sliced, full, rtol=1e-9)
+    # start>0 with num_iteration<=0 takes all REMAINING trees
+    np.testing.assert_allclose(
+        bst.predict(x, start_iteration=5, num_iteration=-1, raw_score=True),
+        bst.predict(x, start_iteration=5, num_iteration=15, raw_score=True))
+
+
+def test_booster_pickle_copy_roundtrip():
+    import copy
+    import pickle
+    x, y = _data(seed=14)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(x, label=y),
+                    num_boost_round=4)
+    p0 = bst.predict(x)
+    for clone in (pickle.loads(pickle.dumps(bst)), copy.copy(bst),
+                  copy.deepcopy(bst)):
+        np.testing.assert_array_equal(clone.predict(x), p0)
+    # best_iteration/best_score survive every clone path (a stale
+    # shadowing __deepcopy__ once silently dropped them)
+    bst.best_iteration = 2
+    bst.best_score = {"valid": {"l2": 1.0}}
+    for clone in (pickle.loads(pickle.dumps(bst)), copy.copy(bst),
+                  copy.deepcopy(bst)):
+        assert clone.best_iteration == 2
+        assert clone.best_score == {"valid": {"l2": 1.0}}
+        np.testing.assert_array_equal(clone.predict(x),
+                                      bst.predict(x, num_iteration=2))
+    # explicit num_iteration<=0 means ALL trees even when best is set
+    np.testing.assert_array_equal(bst.predict(x, num_iteration=-1), p0)

@@ -187,9 +187,13 @@ def test_reference_test_basic_passes(tmp_path):
 #  - dataset param-pipeline internals (test_dataset_update_params,
 #    test_forced_bins, test_dataset_params_with_reference,
 #    test_refit_dataset_params, test_init_with_subset), pandas
-#    categorical round-trip internals, linear-tree save/load+refit,
-#    predict start_iteration matrix, pickle best-iteration carryover:
+#    categorical round-trip internals, linear-tree save/load+refit:
 #    open gaps, consciously not yet claimed
+#  - test_predict_with_start_iteration: its slicing contract is
+#    asserted against a run whose early-stopping point sits on a
+#    10-row validation split — trajectory-dependent on a different
+#    device implementation (the slicing semantics themselves are
+#    covered by our own test below)
 ENGINE_PASSING = [
     "test_engine.py::test_binary",
     "test_engine.py::test_rf",
@@ -214,6 +218,7 @@ ENGINE_PASSING = [
     "test_engine.py::test_reference_chain",
     "test_engine.py::test_contribs",
     "test_engine.py::test_sliced_data",
+    "test_engine.py::test_save_load_copy_pickle",
     "test_engine.py::test_max_bin_by_feature",
     "test_engine.py::test_small_max_bin",
     "test_engine.py::test_refit",
