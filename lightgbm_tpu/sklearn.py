@@ -20,8 +20,26 @@ from .config import Config
 from .dataset import Dataset
 from .engine import train as train_fn
 
+try:
+    # real sklearn estimators (the reference inherits the same bases
+    # through compat): BaseEstimator supplies __sklearn_tags__ /
+    # clone / pipeline / GridSearchCV integration, the mixins tag the
+    # estimator type
+    from sklearn.base import (BaseEstimator as _LGBMModelBase,
+                              ClassifierMixin as _LGBMClassifierBase,
+                              RegressorMixin as _LGBMRegressorBase)
+except ImportError:             # sklearn is optional
+    class _LGBMModelBase:
+        pass
 
-class LGBMModel:
+    class _LGBMClassifierBase:
+        pass
+
+    class _LGBMRegressorBase:
+        pass
+
+
+class LGBMModel(_LGBMModelBase):
     """Base estimator (sklearn.py:343 LGBMModel analog)."""
 
     def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
@@ -99,8 +117,23 @@ class LGBMModel:
             "verbosity": 0,
         }
         if self.random_state is not None:
-            p["seed"] = int(self.random_state)
+            if isinstance(self.random_state, np.random.RandomState):
+                # reference sklearn.py: a RandomState draws one int seed
+                p["seed"] = int(self.random_state.randint(
+                    np.iinfo(np.int32).max))
+            elif isinstance(self.random_state, np.random.Generator):
+                p["seed"] = int(self.random_state.integers(
+                    np.iinfo(np.int32).max))
+            else:
+                p["seed"] = int(self.random_state)
         p.update(self._other_params)
+        if callable(p.get("objective")):
+            # custom objective callable (reference _ObjectiveFunctionWrapper):
+            # training uses fobj; the recorded objective becomes 'none'
+            self._fobj_callable = p["objective"]
+            p["objective"] = "none"
+        else:
+            self._fobj_callable = None
         return p
 
     def _default_objective(self) -> str:
@@ -109,16 +142,45 @@ class LGBMModel:
     # -- fit/predict -------------------------------------------------------
     def fit(self, X, y, sample_weight=None, init_score=None, group=None,
             eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None,
             eval_group=None, eval_metric=None, feval=None,
-            early_stopping_rounds=None, callbacks=None,
+            early_stopping_rounds=None, callbacks=None, init_model=None,
             categorical_feature="auto", feature_name="auto") -> "LGBMModel":
         params = self._lgb_params()
-        y_t = self._process_label(np.asarray(y))
+        from .basic import list_to_1d_numpy
+        y_arr = list_to_1d_numpy(np.asarray(y), dtype=np.float64,
+                                 name="label")
+        y_t = self._process_label(y_arr)
+        if init_model is not None and hasattr(init_model, "booster_"):
+            init_model = init_model.booster_   # fitted estimator
         sample_weight = self._class_weights(sample_weight, y_t)
+        # eval_metric: strings extend the params metric, callables become
+        # feval wrappers (reference sklearn.py _EvalFunctionWrapper:
+        # f(y_true, y_pred) -> (name, value, is_higher_better))
+        fevals = list(feval) if isinstance(feval, (list, tuple)) \
+            else ([feval] if feval else [])
+
+        def _wrap_eval(fn):
+            def _fe(score, dsx):
+                return fn(np.asarray(dsx.get_label()), np.asarray(score))
+            return _fe
+
         if eval_metric is not None:
-            params["metric"] = eval_metric
+            ms = eval_metric if isinstance(eval_metric, list) else [eval_metric]
+            str_metrics = [m for m in ms if isinstance(m, str)]
+            fevals += [_wrap_eval(m) for m in ms if callable(m)]
+            if str_metrics:
+                params["metric"] = str_metrics
         if early_stopping_rounds:
             params["early_stopping_round"] = int(early_stopping_rounds)
+        if self._fobj_callable is not None:
+            fobj_fn = self._fobj_callable
+
+            def _fobj(preds, dsx):
+                return fobj_fn(np.asarray(dsx.get_label()),
+                               np.asarray(preds))
+        else:
+            _fobj = None
 
         ds = Dataset(X, label=y_t, weight=sample_weight, group=group,
                      init_score=init_score, params=params,
@@ -126,14 +188,30 @@ class LGBMModel:
                      categorical_feature=categorical_feature)
         valid_sets, valid_names = [], []
         if eval_set:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]    # a bare (X, y) pair
             for i, (vx, vy) in enumerate(eval_set):
+                name = eval_names[i] if eval_names else f"valid_{i}"
+                if vx is X and (vy is y or
+                                vy is getattr(self, "_train_label_ref",
+                                              None)):
+                    # the training pair in eval_set reports the train
+                    # metrics under its name (reference _get_meta_data)
+                    valid_sets.append(ds)
+                    valid_names.append(name if eval_names else "training")
+                    continue
                 vw = eval_sample_weight[i] if eval_sample_weight else None
+                vy_t = self._encode_eval_label(np.asarray(vy))
+                if eval_class_weight and i < len(eval_class_weight):
+                    cw = self._class_weights(vw, vy_t,
+                                             eval_class_weight[i])
+                    vw = cw if cw is not None else vw
                 vg = eval_group[i] if eval_group else None
+                vis = eval_init_score[i] if eval_init_score else None
                 valid_sets.append(Dataset(
-                    vx, label=self._process_label(np.asarray(vy)), weight=vw,
-                    group=vg, reference=ds))
-                valid_names.append(
-                    eval_names[i] if eval_names else f"valid_{i}")
+                    vx, label=vy_t, weight=vw, group=vg, init_score=vis,
+                    reference=ds))
+                valid_names.append(name)
 
         from .callback import record_evaluation
         evals: Dict = {}
@@ -144,7 +222,9 @@ class LGBMModel:
                                  num_boost_round=self.n_estimators,
                                  valid_sets=valid_sets or None,
                                  valid_names=valid_names or None,
-                                 feval=feval, callbacks=cbs or None)
+                                 feval=fevals or None, fobj=_fobj,
+                                 init_model=init_model,
+                                 callbacks=cbs or None)
         self._n_features = np.asarray(X).shape[1] if hasattr(X, "shape") else \
             len(X[0])
         self.best_iteration_ = self._Booster.best_iteration
@@ -168,7 +248,14 @@ class LGBMModel:
     def _process_label(self, y: np.ndarray) -> np.ndarray:
         return y.astype(np.float32)
 
-    def _class_weights(self, sample_weight, y):
+    def _encode_eval_label(self, y: np.ndarray) -> np.ndarray:
+        """eval_set labels through the same transform as train labels
+        (the classifier maps through its fitted classes)."""
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y.ravel()
+        return self._process_label(y)
+
+    def _class_weights(self, sample_weight, y, class_weight=None):
         return sample_weight
 
     def predict(self, X, raw_score: bool = False, num_iteration=None,
@@ -214,24 +301,28 @@ class LGBMModel:
         return self._Booster.feature_names
 
 
-class LGBMRegressor(LGBMModel):
+class LGBMRegressor(_LGBMRegressorBase, LGBMModel):
     """sklearn.py:919 LGBMRegressor analog."""
 
     def _default_objective(self) -> str:
         return "regression"
 
 
-class LGBMClassifier(LGBMModel):
+class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
     """sklearn.py:~990 LGBMClassifier analog."""
 
     def _default_objective(self) -> str:
         return "binary" if self._n_classes <= 2 else "multiclass"
 
     def fit(self, X, y, **kw):
+        from .basic import list_to_1d_numpy
         y = np.asarray(y)
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = list_to_1d_numpy(y, dtype=y.dtype, name="label")
         self._classes, y_enc = np.unique(y, return_inverse=True)
         self._n_classes = len(self._classes)
         self._y_encoded = y_enc
+        self._train_label_ref = y     # eval_set identity check in base fit
         params_extra = {}
         if self._n_classes > 2:
             params_extra["num_class"] = self._n_classes
@@ -241,19 +332,31 @@ class LGBMClassifier(LGBMModel):
     def _process_label(self, y):
         return y.astype(np.float32)
 
-    def _class_weights(self, sample_weight, y):
-        if self.class_weight is None:
+    def _class_weights(self, sample_weight, y, class_weight=None):
+        cw = class_weight if class_weight is not None else self.class_weight
+        if cw is None:
             return sample_weight
-        if self.class_weight == "balanced":
+        if cw == "balanced":
             counts = np.bincount(y.astype(int), minlength=self._n_classes)
             w_per_class = len(y) / (self._n_classes * np.maximum(counts, 1))
         else:
-            w_per_class = np.asarray([self.class_weight.get(c, 1.0)
+            w_per_class = np.asarray([cw.get(c, 1.0)
                                       for c in range(self._n_classes)])
         w = w_per_class[y.astype(int)]
         if sample_weight is not None:
             w = w * np.asarray(sample_weight)
         return w
+
+    def _encode_eval_label(self, y: np.ndarray) -> np.ndarray:
+        if y.ndim == 2 and y.shape[1] == 1:
+            y = y.ravel()
+        idx = np.searchsorted(self._classes, y)
+        idx = np.clip(idx, 0, len(self._classes) - 1)
+        if not np.array_equal(self._classes[idx], y):
+            raise ValueError(
+                "eval_set contains labels not present in the training "
+                f"classes {list(self._classes)}")
+        return idx.astype(np.float32)
 
     @property
     def classes_(self):
@@ -296,7 +399,15 @@ class LGBMRanker(LGBMModel):
     def _default_objective(self) -> str:
         return "lambdarank"
 
-    def fit(self, X, y, group=None, **kw):
+    def fit(self, X, y, group=None, eval_at=(1, 2, 3, 4, 5), **kw):
         if group is None:
             raise ValueError("LGBMRanker requires group")
-        return super().fit(X, y, group=group, **kw)
+        # eval_at rides the params for this fit only (reference
+        # LGBMRanker.fit: params['eval_at'] = self.eval_at)
+        saved = dict(self._other_params)
+        try:
+            self._other_params = dict(self._other_params,
+                                      eval_at=list(eval_at))
+            return super().fit(X, y, group=group, **kw)
+        finally:
+            self._other_params = saved
