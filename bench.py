@@ -344,6 +344,23 @@ def child_extras() -> None:
             _record_point("higgs1m_255leaf_strict",
                           error=f"{type(e).__name__}: {e}"[:200])
 
+    # owner-shard dp histogram state (ISSUE 1 / VERDICT #63): per-shard
+    # histogram bytes per leaf after the psum_scatter, vs the full-psum
+    # replication — the memory shape tools/bench_hist.py --sharded times
+    try:
+        from lightgbm_tpu.parallel.mesh import owner_shard_plan
+        pts = {}
+        for wname, f in (("higgs28", 28), ("bosch968", 968),
+                         ("allstate4228", 4228)):
+            for s in (8, 16):
+                plan = owner_shard_plan(np.arange(f), s)
+                pts[f"{wname}_x{s}"] = plan.hist_bytes(1, 64)
+            pts[f"{wname}_full"] = f * 64 * 3 * 4
+        _record_point("dp_owner_shard_hist_bytes_per_leaf", cpu=cpu, **pts)
+    except Exception as e:
+        _record_point("dp_owner_shard_hist_bytes_per_leaf",
+                      error=f"{type(e).__name__}: {e}"[:200])
+
     if cpu:
         return                       # 10M-row point is TPU-only
     # 10M-row scaling point (VERDICT r2 task 3b)

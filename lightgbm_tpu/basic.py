@@ -88,15 +88,22 @@ class _ConfigAliases:
 def _choose_param_value(main_param_name: str, params: Dict[str, Any],
                         default_value: Any) -> Dict[str, Any]:
     """One value for ``main_param_name`` with every alias removed; the
-    canonical spelling wins over aliases, aliases win over the default
-    (basic.py:391 contract)."""
+    canonical spelling wins over aliases — by PRESENCE, so an explicit
+    ``None`` under the canonical key is preserved rather than overridden
+    by an alias (the reference returns immediately when the main name is
+    in params) — and aliases win over the default (basic.py:391
+    contract)."""
     params = deepcopy(params)
+    found_main = main_param_name in params
     found = params.get(main_param_name)
     for alias in _ConfigAliases.get(main_param_name):
         val = params.pop(alias, None)
-        if found is None and val is not None:
+        if not found_main and found is None and val is not None:
             found = val
-    params[main_param_name] = default_value if found is None else found
+    if found_main:
+        params[main_param_name] = found
+    else:
+        params[main_param_name] = default_value if found is None else found
     return params
 
 

@@ -341,8 +341,16 @@ class Booster:
             # LibSVM sniffed by the loader
             from .data_io import load_text
             data, _ = load_text(str(data))
+        # reference contract (c_api predict + basic.py): the feature-count
+        # mismatch only raises when predict_disable_shape_check is false
+        # (config, or a predict-time override), and the error tells the
+        # user about the param
+        disable_shape_check = bool(kw.get(
+            "predict_disable_shape_check",
+            self.config.predict_disable_shape_check))
         if hasattr(data, "shape") and len(getattr(data, "shape", ())) == 2 \
-                and data.shape[1] != self._max_feature_idx + 1:
+                and data.shape[1] != self._max_feature_idx + 1 \
+                and not disable_shape_check:
             # checked BEFORE the chunked-sparse recursion and without a
             # truthiness guard (a 1-feature model has _max_feature_idx
             # == 0 — falsy, but the check must still fire)
@@ -350,7 +358,10 @@ class Booster:
             raise LightGBMError(
                 f"The number of features in data ({data.shape[1]}) is "
                 f"not the same as it was in training data "
-                f"({self._max_feature_idx + 1}).")
+                f"({self._max_feature_idx + 1}).\n"
+                "You can set ``predict_disable_shape_check=true`` to "
+                "discard this error, but please be aware what you are "
+                "doing.")
         if _is_scipy_sparse(data) and data.shape[0] > 65536:
             # CSR prediction (LGBM_BoosterPredictForCSR analog): densify in
             # row chunks so peak memory stays bounded.
@@ -362,16 +373,34 @@ class Booster:
                                    pred_contrib=pred_contrib,
                                    pred_early_stop=pred_early_stop,
                                    pred_early_stop_freq=pred_early_stop_freq,
-                                   pred_early_stop_margin=pred_early_stop_margin)
+                                   pred_early_stop_margin=pred_early_stop_margin,
+                                   **kw)
                       for i in range(0, data.shape[0], 65536)]
             return np.concatenate(chunks, axis=0)
         x, _, _ = _to_numpy_2d(data)
         if x.shape[1] != self._max_feature_idx + 1:
-            from .basic import LightGBMError
-            raise LightGBMError(
-                f"The number of features in data ({x.shape[1]}) is not "
-                f"the same as it was in training data "
-                f"({self._max_feature_idx + 1}).")
+            if not disable_shape_check:
+                from .basic import LightGBMError
+                raise LightGBMError(
+                    f"The number of features in data ({x.shape[1]}) is not "
+                    f"the same as it was in training data "
+                    f"({self._max_feature_idx + 1}).\n"
+                    "You can set ``predict_disable_shape_check=true`` to "
+                    "discard this error, but please be aware what you are "
+                    "doing.")
+            # shape check disabled: the reference Predictor copies each
+            # row into a ZERO-initialized num_feature buffer, so a
+            # missing tail of features compares as 0.0 (a regular value
+            # under the default zero_as_missing=false) — zero-fill, not
+            # NaN; extra columns are ignored (trees only read trained
+            # feature ids)
+            nf_model = self._max_feature_idx + 1
+            if x.shape[1] < nf_model:
+                x = np.concatenate(
+                    [x, np.zeros((len(x), nf_model - x.shape[1]),
+                                 dtype=x.dtype)], axis=1)
+            else:
+                x = x[:, :nf_model]
         n = len(x)
         k = self._num_tree_per_iteration
         start_iteration = max(0, start_iteration)

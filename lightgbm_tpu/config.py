@@ -178,6 +178,12 @@ _PARAMS: Dict[str, tuple] = {
     # ---- TPU-specific (new axis, cf. SURVEY.md §1 device dimension) ----
     "mesh_shape": (list, None, []),          # one axis, e.g. [8]
     "mesh_axis_names": (list, None, []),     # one axis, e.g. ["data"]
+    # tree_learner=data histogram reduction: true = reduce-scatter the
+    # feature-chunked histograms so each shard carries only [L, F/n, B, 3]
+    # of GLOBAL histograms (the reference's ReduceScatter owner shape,
+    # data_parallel_tree_learner.cpp:174-186); false = legacy full psum
+    # (every shard holds all global histograms) — A/B escape hatch
+    "dp_owner_shard": (bool, True, []),
     "hist_dtype": (str, "float32", []),      # histogram accumulation dtype
     # auto: partitioned on CPU, masked (one jitted program per tree) on
     # accelerators where per-split host round-trips dominate

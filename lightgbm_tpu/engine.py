@@ -25,13 +25,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
           valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None,
           feval: Optional[Callable] = None,
           init_model: Optional[Union[str, Booster]] = None,
           feature_name="auto", categorical_feature="auto",
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None,
-          fobj: Optional[Callable] = None) -> Booster:
-    """Train a gradient-boosted model (engine.py:25 analog)."""
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """Train a gradient-boosted model (engine.py:25 analog).
+
+    ``fobj`` sits in the reference's positional slot — between
+    ``valid_names`` and ``feval`` (v3.3.2 engine.py:25), matching ``cv``
+    — so reference-style positional calls bind the custom objective and
+    custom metric to the right parameters."""
     params = dict(params or {})
     cfg = Config(params)
     from .config import canonical_params

@@ -113,7 +113,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 max_depth: int = -1, block_rows: int = 0,
                 hist_reduce: Optional[Callable] = None,
                 hist_view: Optional[Callable] = None,
+                hist_expand: Optional[Callable] = None,
                 select_best: Optional[Callable] = None,
+                mono_view: Optional[Callable] = None,
                 subtract: bool = True,
                 gather: bool = False, min_gather_rows: int = 4096,
                 count_reduce: Optional[Callable] = None,
@@ -134,7 +136,22 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
     Parallelism hooks (SURVEY.md §2.6 strategies map onto one program):
     - hist_reduce: reduce local histograms across the mesh row axis
-      (data-parallel psum; identity for serial).
+      (data-parallel psum; identity for serial).  The hook may SHRINK the
+      feature axis: the owner-shard data-parallel learner reduce-scatters
+      a feature-chunked layout (``lax.psum_scatter``) so each shard's
+      histogram carry holds only its owned chunk of the GLOBAL
+      histograms — the carry and every child histogram follow the
+      reduced shape, never the local-view width.
+    - hist_expand: maps the (possibly owner-chunked) reduced histogram
+      plus the leaf totals into the SPLIT-SCAN feature space — replaces
+      the built-in EFB group->feature expansion when the scan space is a
+      per-shard slice (owner-shard dp; identity slicing without EFB).
+      ``num_bin``/``na_bin``/``feature_mask``/``is_cat`` must then be the
+      scan-space slices, while ``na_bin_part``/``num_bin_part`` carry the
+      global arrays for row partitioning.
+    - mono_view: maps the global [F] monotone-constraint vector into the
+      split-scan feature space (owner-shard dp); partitioning keeps the
+      global vector (the winning feature id is global).
     - hist_view:   restrict the binned matrix to this shard's feature slice
       before histogram work (feature-parallel; identity for serial).
       ``feature_mask``/``num_bin``/``na_bin`` must then be the local slices,
@@ -206,8 +223,14 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     use_subtraction = subtract
     Bh = int(efb.group_bins) if efb is not None else B   # histogram bin axis
     if efb is not None:
-        from .efb import expand_group_hist
         efb_off_dev = jnp.asarray(efb.off_host)
+    if hist_expand is not None:
+        # owner-shard distribution: the reduced histogram is this shard's
+        # chunk of the global one; the hook views it in scan space
+        # (including the EFB group->feature expansion, done per shard)
+        _expand = hist_expand
+    elif efb is not None:
+        from .efb import expand_group_hist
 
         def _expand(gh, total):
             return expand_group_hist(gh, total, efb.group_of_feat,
@@ -278,6 +301,14 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                                          jnp.float32)
     mono_dev = None if mono is None else jnp.asarray(mono, jnp.int32)
     use_mono = mono_dev is not None
+
+    def _scan_mono():
+        """Monotone vector in SPLIT-SCAN feature space: owner-shard
+        learners scan only their owned feature chunk (mono_view gathers
+        the slice in-graph); identity otherwise.  Partitioning and child
+        range propagation keep indexing the GLOBAL ``mono_dev`` — the
+        winning feature id is global after select_best."""
+        return mono_dev if mono_view is None else mono_view(mono_dev)
     inter_dev = None if interaction_groups is None \
         else jnp.asarray(interaction_groups, bool)     # [G, F]
     use_inter = inter_dev is not None
@@ -333,9 +364,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     def _mono_gain_scale(depth):
         """Per-feature [F] penalty scale on monotone features, composed
         with ``gain_scale`` (shared formula: ops/split.py
-        monotone_penalty_factor)."""
+        monotone_penalty_factor); scan-space under owner sharding."""
         factor = monotone_penalty_factor(mono_penalty, depth)
-        gs = jnp.where(mono_dev != 0, factor, 1.0).astype(jnp.float32)
+        gs = jnp.where(_scan_mono() != 0, factor, 1.0).astype(jnp.float32)
         return gs if gscale is None else gs * gscale
 
     def _best2(hist2, totals2, num_bin, na_bin, fmask, parent_out2, is_cat,
@@ -364,7 +395,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             if use_mono:
                 lo, hi, d = rest[i], rest[i + 1], rest[i + 2]
                 i += 3
-                kw.update(mono=mono_dev, out_lo=lo, out_hi=hi)
+                kw.update(mono=_scan_mono(), out_lo=lo, out_hi=hi)
                 kw["gain_scale"] = _mono_gain_scale(d) \
                     if mono_penalty > 0.0 else gscale
             else:
@@ -439,7 +470,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                       fmask_root)
         kw = {"gain_scale": gscale, "rand_bin": rb0}
         if use_mono:
-            kw.update(mono=mono_dev, out_lo=jnp.float32(-jnp.inf),
+            kw.update(mono=_scan_mono(), out_lo=jnp.float32(-jnp.inf),
                       out_hi=jnp.float32(jnp.inf))
             if mono_penalty > 0.0:
                 kw["gain_scale"] = _mono_gain_scale(jnp.int32(0))
@@ -497,13 +528,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
     def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
                   na_bin_part=None, is_cat=None,
-                  rng_iter=None, cegb_used=None) -> TreeArrays:
+                  rng_iter=None, cegb_used=None,
+                  num_bin_part=None) -> TreeArrays:
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
-        f = binned_view.shape[1]
         child_hist = _make_child_hist(n)
         if na_bin_part is None:
             na_bin_part = na_bin
+        if num_bin_part is None:
+            num_bin_part = num_bin
         cuse0 = None
         if use_cegb:
             cuse0 = cegb_used if cegb_used is not None \
@@ -512,7 +545,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         hist0, total0, root_out, res0, et_key, bn_key = _root_eval(
             binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
             rng_iter, cuse0)
-        st = _init_state(n, L, L - 1, binned_view.shape[1],
+        # the carry follows the REDUCED histogram's feature axis, not the
+        # binned view's: an owner-shard hist_reduce leaves each shard with
+        # only its chunk of the global histograms ([L, F/n, B, 3])
+        st = _init_state(n, L, L - 1, hist0.shape[0],
                          feature_mask.shape[0], hist0, total0,
                          root_out, res0, cuse0)
 
@@ -552,7 +588,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     gcol = jnp.take(binned, efb.group_of_feat[feat],
                                     axis=1).astype(jnp.int32)
                     off = efb_off_dev[feat]
-                    in_range = (gcol >= off) & (gcol < off + num_bin[feat] - 1)
+                    in_range = (gcol >= off) \
+                        & (gcol < off + num_bin_part[feat] - 1)
                     fcol = jnp.where(off < 0, gcol,
                                      jnp.where(in_range, gcol - off + 1, 0))
                 nb = na_bin_part[feat]
@@ -710,7 +747,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
     def grow_tree_batched(binned, vals, feature_mask, num_bin, na_bin,
                           na_bin_part=None, is_cat=None,
-                          rng_iter=None, cegb_used=None) -> TreeArrays:
+                          rng_iter=None, cegb_used=None,
+                          num_bin_part=None) -> TreeArrays:
         """K-splits-per-super-step grower (split_batch above).
 
         Per-leaf state arrays carry K scratch slots past the real range
@@ -720,9 +758,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         program and the scratch writes are sliced off at the end."""
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
-        fv = binned_view.shape[1]
         if na_bin_part is None:
             na_bin_part = na_bin
+        if num_bin_part is None:
+            num_bin_part = num_bin
         LP, NP = L + K, (L - 1) + K
         cuse0 = None
         if use_cegb:
@@ -732,7 +771,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         hist0, total0, root_out, res0, et_key, bn_key = _root_eval(
             binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
             rng_iter, cuse0)
-        st = _init_state(n, LP, NP, fv, feature_mask.shape[0], hist0,
+        # carry feature axis = the REDUCED histogram's (owner-shard chunk
+        # under the scatter-reducing dp learner; the view width otherwise)
+        fh = hist0.shape[0]
+        st = _init_state(n, LP, NP, fh, feature_mask.shape[0], hist0,
                          total0, root_out, res0, cuse0)
 
         neg_inf = jnp.float32(-jnp.inf)
@@ -786,7 +828,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                         .astype(jnp.int32)
                     off = efb_off_dev[feat_r]
                     in_range = (gcol >= off) \
-                        & (gcol < off + num_bin[feat_r] - 1)
+                        & (gcol < off + num_bin_part[feat_r] - 1)
                     fcol = jnp.where(off < 0, gcol,
                                      jnp.where(in_range, gcol - off + 1, 0))
                 nb_r = na_bin_part[feat_r]
@@ -806,9 +848,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     .at[targets].set(jnp.arange(nC, dtype=jnp.int32))
                 tslot = tslot_of_leaf[leaf_of_row]           # [N]
                 hist_c = _hist(binned_view, vals, tslot,
-                               nC)                           # [Fv, Bh, 3nC]
-                hist_c = hist_c.reshape(fv, Bh, 3, nC) \
-                    .transpose(3, 0, 1, 2)                   # [nC, Fv, Bh, 3]
+                               nC)                           # [Fh, Bh, 3nC]
+                hist_c = hist_c.reshape(fh, Bh, 3, nC) \
+                    .transpose(3, 0, 1, 2)                   # [nC, Fh, Bh, 3]
                 if use_subtraction:
                     hist_small = hist_c
                     hist_large = st.hist[leaf_sel] - hist_small

@@ -239,8 +239,9 @@ class GBDTModel:
         # device-resident binned matrix + per-feature bin metadata.
         # EFB (efb.py): the grouped layout is used by the single-chip
         # learners AND the data-parallel learner, where it shrinks the
-        # histogram psum payload (dataset.cpp:239 bundles before the
-        # reduce-scatter, data_parallel_tree_learner.cpp:174-186).
+        # histogram reduce-scatter payload and the owner-shard chunk axis
+        # (dataset.cpp:239 bundles before the reduce-scatter,
+        # data_parallel_tree_learner.cpp:174-186).
         # Feature-parallel shards the feature axis (bundles would straddle
         # shards) and voting votes per feature, so both keep flat layout.
         self._use_efb = (ds.efb is not None and hist_reduce is None
@@ -418,7 +419,10 @@ class GBDTModel:
                 split_batch=self._split_batch,
                 mono=self._mono if mono_masked_ok else None,
                 mono_penalty=config.monotone_penalty,
-                sparse=self._sparse)
+                sparse=self._sparse,
+                # owner-shard reduce-scatter (dp_owner_shard=false falls
+                # back to the full-psum reduction for A/B comparison)
+                owner_shard=config.dp_owner_shard)
         elif dist == "voting":
             from ..parallel.voting_parallel import make_voting_grower
             self.grower = make_voting_grower(

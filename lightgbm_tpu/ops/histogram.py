@@ -46,6 +46,18 @@ def hist_block_rows(num_features: int, padded_bins: int) -> int:
     return max(8, min(HIST_BLOCK_ROWS, blk // 8 * 8))
 
 
+def pad_feature_axis(h: jax.Array, total: int) -> jax.Array:
+    """Zero-pad the leading (feature/group) axis of a histogram to
+    ``total`` rows.  The owner-shard reduce-scatter
+    (parallel/data_parallel.py) needs the histogram's chunk axis to
+    divide evenly over the mesh; zero rows reduce to zero and are never
+    scanned (their scan slots carry a False feature mask)."""
+    pad = total - h.shape[0]
+    if pad <= 0:
+        return h
+    return jnp.pad(h, ((0, pad),) + ((0, 0),) * (h.ndim - 1))
+
+
 def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
                       block_rows: int = 0, slot: Optional[jax.Array] = None,
                       num_slots: int = 1) -> jax.Array:

@@ -20,6 +20,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 kEpsilon = 1e-15
 kMinScore = -jnp.inf
@@ -60,6 +61,39 @@ class SplitResult(NamedTuple):
     right_output: jax.Array  # f32
     is_cat: jax.Array        # bool
     bin_rank: jax.Array      # [B] int32 rank of each bin in the decision order
+
+
+def globalize_feature(res: SplitResult, gfid: jax.Array) -> SplitResult:
+    """Map a chunk-local winning feature slot back to its GLOBAL feature
+    id via the owner-shard slot map ``gfid`` [f_local] (-1 = padding).
+
+    Used by the sharded learners (feature-parallel contiguous slices map
+    with an offset instead; the data-parallel owner-shard chunks are
+    non-contiguous under EFB, hence the explicit map).  A pad slot can
+    only win when every candidate is invalid (gain -inf), in which case
+    the serial scan's argmax also degenerates to slot 0 — clamping to
+    feature 0 keeps the two bit-identical."""
+    return res._replace(feature=jnp.maximum(jnp.take(gfid, res.feature), 0))
+
+
+def gather_best(res: SplitResult, axis_name: str) -> SplitResult:
+    """``SyncUpGlobalBestSplit`` (parallel_tree_learner.h:191): allgather
+    each shard's best candidate over ``axis_name`` and keep the winner.
+    This is the entire cross-shard communication of a split decision — a
+    few scalars plus the [B] decision-rank vector, never a histogram.
+    ``res.feature`` must already be a GLOBAL feature id (see
+    ``globalize_feature``).
+
+    Exact-gain ties across shards break toward the LOWEST GLOBAL FEATURE
+    ID, matching the serial scan's flat argmax — lowest-shard-index would
+    instead follow EFB group order, which need not follow feature order
+    (duplicated columns bundled into different groups would then split on
+    a different feature than serial).  Within a shard the local argmax
+    already reproduces serial's (dir, feature, bin) order."""
+    g = lax.all_gather(res, axis_name)       # one collective: pytree [S, ...]
+    tie = g.gain == jnp.max(g.gain)
+    win = jnp.argmin(jnp.where(tie, g.feature, jnp.int32(2 ** 30)))
+    return jax.tree.map(lambda a: a[win], g)
 
 
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:

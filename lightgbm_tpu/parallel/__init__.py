@@ -1,4 +1,6 @@
-from .mesh import make_mesh, default_mesh, init_distributed
-from .data_parallel import make_dp_grower, shard_rows, pad_to_multiple
+from .mesh import (make_mesh, default_mesh, init_distributed,
+                   OwnerShardPlan, owner_shard_plan)
+from .data_parallel import (make_dp_grower, shard_rows, pad_to_multiple,
+                            owner_hist_reduce)
 from .feature_parallel import make_fp_grower
 from .voting_parallel import make_voting_grower

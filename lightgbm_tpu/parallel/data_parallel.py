@@ -5,23 +5,38 @@ TPU-native redesign of the reference DataParallelTreeLearner
 
 - rows live sharded; every shard builds LOCAL histograms for all features;
 - the reference's ``Network::ReduceScatter(hists, HistogramSumReducer)``
-  (:185) + ``SyncUpGlobalBestSplit`` allgather (:260) collapse into ONE
-  ``lax.psum`` of the histogram tensor over the mesh axis — after which the
-  split decision is computed REPLICATED on every shard (no separate
-  best-split sync needed, and XLA is free to lower the psum as
-  reduce-scatter + all-gather over ICI);
-- the root Σgrad/Σhess allreduce (:126-152) falls out of the same psum
-  (totals are a histogram marginal);
+  (:185) is a real ``lax.psum_scatter`` over a feature-chunked histogram
+  layout: the feature-group axis is padded to ``n_shards`` equal chunks
+  and reduce-scattered, so each shard ends up holding only ITS chunk of
+  the GLOBAL histograms — the grower's per-shard histogram carry is
+  ``[L, G/n_shards, B, 3]`` and per-chip histogram state stops scaling
+  with the global feature width (the owner-shard memory shape the
+  reference gets from ReduceScatter; arXiv:1611.01276's communication
+  pattern for distributed tree induction);
+- the split scan (ops/split.py) runs on the owned slice only; the
+  per-shard best ``SplitResult`` is globalized back to global feature ids
+  and allgathered (``SyncUpGlobalBestSplit``, parallel_tree_learner.h:191)
+  — a few scalars plus the [B] rank vector per leaf cross the
+  interconnect, never a histogram tensor;
+- the histogram subtraction trick runs POST-scatter, on owned features
+  only (parent chunk - smaller-child chunk);
+- the root Σgrad/Σhess allreduce (:126-152) stays one tiny [3] psum;
 - row partition stays local (no row data ever moves, like the reference).
 
-The same grower program (grower.py) is used — distribution is a
-``shard_map`` wrapper + a psum hook, not a separate learner implementation.
+``owner_shard=False`` restores the previous design — ONE full-tensor
+``lax.psum`` of ``[F, B, 3]`` with the split decision recomputed
+replicated on every shard — kept for A/B benchmarking
+(tools/bench_hist.py --sharded) and as a config escape hatch
+(``dp_owner_shard=false``).
+
+The same grower program (grower.py) is used for both — distribution is a
+``shard_map`` wrapper plus reduce/expand/select hooks, not a separate
+learner implementation.  With ``efb`` the chunked axis is the BUNDLED
+group axis — exactly where the reference bundles before its
+reduce-scatter (dataset.cpp:239; data_parallel_tree_learner.cpp:174-186).
 """
 
 from __future__ import annotations
-
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +45,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..grower import TreeArrays, make_grower
-from ..ops.split import SplitParams
+from ..ops.histogram import pad_feature_axis
+from ..ops.split import (SplitParams, SplitResult, gather_best,
+                         globalize_feature)
+from ..utils.jax_compat import shard_map
+from .mesh import owner_shard_plan
 
 
 def pad_to_multiple(n: int, k: int) -> int:
@@ -55,11 +74,38 @@ def shard_rows(mesh: Mesh, arr, axis: str = "data"):
     return jax.device_put(jnp.asarray(arr), sharding)
 
 
+def _dp_out_specs(axis: str) -> TreeArrays:
+    """Tree fields replicated, the row->leaf vector row-sharded."""
+    return TreeArrays(
+        num_leaves=P(), split_feature=P(), threshold_bin=P(),
+        default_left=P(), left_child=P(), right_child=P(), split_gain=P(),
+        leaf_value=P(), leaf_weight=P(), leaf_count=P(), internal_value=P(),
+        internal_weight=P(), internal_count=P(), leaf_depth=P(),
+        leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P(), n_steps=P())
+
+
+def owner_hist_reduce(axis: str, n_shards: int, chunk: int):
+    """The ReduceScatter hook: pad the histogram's feature-group axis to
+    ``n_shards * chunk`` rows and ``psum_scatter`` it, leaving each shard
+    with its owned ``[chunk, B, C]`` slice of the GLOBAL histograms
+    (data_parallel_tree_learner.cpp:185's communication shape; XLA
+    lowers this to a true reduce-scatter over ICI, moving 1/n_shards of
+    the bytes a full psum replicates to every chip)."""
+    total = n_shards * chunk
+
+    def hist_reduce(h):
+        return lax.psum_scatter(pad_feature_axis(h, total), axis,
+                                scatter_dimension=0, tiled=True)
+
+    return hist_reduce
+
+
 def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    params: SplitParams, max_depth: int = -1,
                    block_rows: int = 0, axis: str = "data", efb=None,
                    split_batch: int = 1, mono=None,
-                   mono_penalty: float = 0.0, sparse: bool = False):
+                   mono_penalty: float = 0.0, sparse: bool = False,
+                   owner_shard: bool = True):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
     Inputs: binned [N, F] (or the bundled [N, G] group matrix when ``efb``
@@ -67,11 +113,160 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
     Output tree arrays are replicated; ``leaf_of_row`` stays row-sharded.
     Child histograms use the masked full pass (gather tiers measured slower
     on TPU — PROFILE.md §2), which also keeps every shard's collective
-    schedule trivially congruent.  With ``efb`` the psum payload shrinks to
-    the bundled group-space histograms — exactly where the reference
-    bundles before reduce-scatter (dataset.cpp:239;
-    data_parallel_tree_learner.cpp:174-186).
+    schedule trivially congruent.
+
+    owner_shard=True (default): reduce-scatter + owned-slice split scan +
+    best-split allgather (module docstring).  False: the legacy full
+    ``lax.psum`` with replicated split decisions.
     """
+    kw = dict(num_leaves=num_leaves, num_bins=num_bins, params=params,
+              max_depth=max_depth, block_rows=block_rows, axis=axis,
+              efb=efb, split_batch=split_batch, mono=mono,
+              mono_penalty=mono_penalty, sparse=sparse)
+    if owner_shard:
+        return _make_dp_owner_grower(mesh, **kw)
+    return _make_dp_psum_grower(mesh, **kw)
+
+
+def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
+                          max_depth, block_rows, axis, efb, split_batch,
+                          mono, mono_penalty, sparse):
+    """Owner-shard data-parallel grower (see module docstring)."""
+    n_shards = mesh.shape[axis]
+    out_specs = _dp_out_specs(axis)
+    cache = {}
+
+    def _build(nf: int, sparse_key=None):
+        group_of = np.asarray(efb.group_host) if efb is not None \
+            else np.arange(nf)
+        plan = owner_shard_plan(group_of, n_shards)
+        sf_dev = jnp.asarray(plan.shard_feat)        # [S, fmax] global ids
+        chunk, fmax = plan.chunk, plan.fmax
+        hist_reduce = owner_hist_reduce(axis, n_shards, chunk)
+
+        def _gfid():
+            """This shard's scan-slot -> global-feature map (in-graph)."""
+            return sf_dev[lax.axis_index(axis)]
+
+        if efb is not None:
+            # per-shard EFB expansion: owned-groups histogram
+            # [chunk, Bg, C] -> scan feature space [fmax, B, C], with the
+            # FixHistogram default-bin reconstruction (dataset.cpp:1292)
+            # done from the leaf totals on owned features only
+            bg = int(efb.group_bins)
+            g_of = efb.group_of_feat
+
+            def hist_expand(gh, total):
+                idx = lax.axis_index(axis)
+                gfid = sf_dev[idx]
+                safe = jnp.maximum(gfid, 0)
+                ok = gfid >= 0
+                glocal = jnp.clip(jnp.take(g_of, safe) - idx * chunk,
+                                  0, gh.shape[0] - 1)
+                src = jnp.take(gh, glocal, axis=0)       # [fmax, Bg, C]
+                ci = jnp.take(efb.col_idx, safe, axis=0)  # [fmax, B]
+                fh = jnp.take_along_axis(
+                    src, jnp.clip(ci, 0, bg - 1)[:, :, None], axis=1)
+                fh = jnp.where((ok[:, None] & (ci >= 0))[:, :, None],
+                               fh, 0.0)
+                rest = fh[:, 1:, :].sum(axis=1)
+                bin0 = jnp.where((jnp.take(efb.fix0, safe) & ok)[:, None],
+                                 total[None, :] - rest, fh[:, 0, :])
+                return fh.at[:, 0, :].set(bin0)
+        else:
+            # unbundled: group == feature, owned features are the
+            # contiguous chunk — the scan view just trims reduce padding
+            def hist_expand(h, total):
+                return lax.slice_in_dim(h, 0, fmax, axis=0)
+
+        def mono_view(m):
+            gfid = _gfid()
+            return jnp.where(gfid >= 0,
+                             jnp.take(m, jnp.maximum(gfid, 0)), 0)
+
+        def select_best(res: SplitResult) -> SplitResult:
+            return gather_best(globalize_feature(res, _gfid()), axis)
+
+        inner = make_grower(
+            num_leaves=num_leaves, num_bins=num_bins, params=params,
+            max_depth=max_depth, block_rows=block_rows,
+            hist_reduce=hist_reduce,
+            sum_reduce=lambda t: lax.psum(t, axis),
+            hist_expand=hist_expand, select_best=select_best,
+            efb=efb, split_batch=split_batch, mono=mono,
+            mono_view=None if mono is None else mono_view,
+            mono_penalty=mono_penalty, jit=False)
+
+        def _localize(fmask, nb, na, ic):
+            """Scan-space metadata slices for this shard's owned
+            features; pad slots are masked (and given harmless bins)."""
+            gfid = _gfid()
+            safe = jnp.maximum(gfid, 0)
+            ok = gfid >= 0
+            return (fmask[safe] & ok,
+                    jnp.where(ok, nb[safe], 2),
+                    jnp.where(ok, na[safe], -1),
+                    ic[safe] & ok)
+
+        if sparse_key is not None:
+            from ..sparse_data import SparseBinned
+            stride, nfs = sparse_key
+
+            def wrapped(flat, db, vals, fmask, nb, na, nabp, ic):
+                fm_l, nb_l, na_l, ic_l = _localize(fmask, nb, na, ic)
+                return inner(SparseBinned(flat, db, stride, nfs), vals,
+                             fm_l, nb_l, na_l, nabp, ic_l,
+                             num_bin_part=nb)
+
+            in_specs = (P(axis, None), P(None), P(axis, None),
+                        P(), P(), P(), P(), P())
+        else:
+            def wrapped(binned, vals, fmask, nb, na, nabp, ic):
+                fm_l, nb_l, na_l, ic_l = _localize(fmask, nb, na, ic)
+                return inner(binned, vals, fm_l, nb_l, na_l, nabp, ic_l,
+                             num_bin_part=nb)
+
+            in_specs = (P(axis, None), P(axis, None),
+                        P(), P(), P(), P(), P())
+
+        fn = jax.jit(shard_map(wrapped, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False))
+        return fn, plan
+
+    def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
+        if is_cat is None:
+            is_cat = jnp.zeros(num_bin.shape[0], bool)
+        nf = int(num_bin.shape[0])
+        if sparse:
+            key = (nf, binned.stride, binned.num_features)
+            if key not in cache:
+                cache[key] = _build(nf, (binned.stride,
+                                         binned.num_features))
+            fn, plan = cache[key]
+            grow.plan = plan
+            return fn(binned.flat, binned.default_bin, vals, feature_mask,
+                      num_bin, na_bin, na_bin, is_cat)
+        if nf not in cache:
+            cache[nf] = _build(nf)
+        fn, plan = cache[nf]
+        grow.plan = plan
+        return fn(binned, vals, feature_mask, num_bin, na_bin, na_bin,
+                  is_cat)
+
+    grow.owner_shard = True
+    if efb is not None:
+        # bundle structure is static: expose the plan before the first call
+        grow.plan = owner_shard_plan(np.asarray(efb.group_host), n_shards)
+    return grow
+
+
+def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
+                         max_depth, block_rows, axis, efb, split_batch,
+                         mono, mono_penalty, sparse):
+    """Legacy full-psum data-parallel grower: every shard receives ALL
+    global histograms and recomputes the split decision replicated (no
+    separate best-split sync needed — but per-chip histogram state scales
+    with the full feature width; see the owner-shard default)."""
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
@@ -80,12 +275,7 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         split_batch=split_batch, mono=mono, mono_penalty=mono_penalty,
         jit=False)
 
-    out_specs = TreeArrays(
-        num_leaves=P(), split_feature=P(), threshold_bin=P(),
-        default_left=P(), left_child=P(), right_child=P(), split_gain=P(),
-        leaf_value=P(), leaf_weight=P(), leaf_count=P(), internal_value=P(),
-        internal_weight=P(), internal_count=P(), leaf_depth=P(),
-        leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P(), n_steps=P())
+    out_specs = _dp_out_specs(axis)
 
     if sparse:
         # SparseBinned pytree (sparse_data.py): the flat [N, K] entry
@@ -101,7 +291,7 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
             def wrapped(flat, db, vals, fm, nb, nab, nabp, ic):
                 return inner(SparseBinned(flat, db, stride, nf), vals,
                              fm, nb, nab, nabp, ic)
-            return jax.shard_map(
+            return shard_map(
                 wrapped, mesh=mesh,
                 in_specs=(P(axis, None), P(None), P(axis, None),
                           P(), P(), P(), P(), P()),
@@ -117,16 +307,21 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                               feature_mask, num_bin, na_bin, na_bin,
                               is_cat)
 
+        grow.owner_shard = False
         return grow
 
-    f = jax.shard_map(
+    f = shard_map(
         inner, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
         out_specs=out_specs, check_vma=False)
 
+    jitted = jax.jit(
+        lambda b, v, fm, nb, na, ic: f(b, v, fm, nb, na, na, ic))
+
     def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
         if is_cat is None:
             is_cat = jnp.zeros(num_bin.shape[0], bool)
-        return f(binned, vals, feature_mask, num_bin, na_bin, na_bin, is_cat)
+        return jitted(binned, vals, feature_mask, num_bin, na_bin, is_cat)
 
-    return jax.jit(grow)
+    grow.owner_shard = False
+    return grow

@@ -21,7 +21,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..grower import TreeArrays, make_grower
-from ..ops.split import SplitParams, SplitResult
+from ..ops.split import SplitParams, SplitResult, gather_best
+from ..utils.jax_compat import shard_map
 
 
 def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
@@ -47,14 +48,11 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
         return lax.dynamic_slice_in_dim(binned, idx * f_local, f_local, axis=1)
 
     def select_best(res: SplitResult) -> SplitResult:
+        # contiguous slices globalize by offset; the winner sync is the
+        # shared SyncUpGlobalBestSplit allgather (ops/split.gather_best)
         idx = lax.axis_index(axis)
         res = res._replace(feature=res.feature + idx * f_local)
-        gains = lax.all_gather(res.gain, axis)          # [S]
-        win = jnp.argmax(gains)                         # tie -> lowest shard
-
-        def pick(x):
-            return lax.all_gather(x, axis)[win]
-        return SplitResult(*(pick(field) for field in res))
+        return gather_best(res, axis)
 
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
@@ -65,7 +63,7 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
     out_specs = jax.tree.map(lambda _: P(), TreeArrays(
         *(0,) * len(TreeArrays._fields)))
 
-    f = jax.shard_map(
+    f = shard_map(
         inner, mesh=mesh,
         in_specs=(P(None, None), P(None, None), P(axis), P(axis), P(axis),
                   P(None), P(axis)),

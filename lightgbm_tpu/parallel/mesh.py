@@ -11,7 +11,7 @@ analog, c_api.h:1350) which wires the same collectives across hosts.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -44,6 +44,53 @@ def default_mesh(num: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     num = num or len(devs)
     return make_mesh((num,), ("data",), devs)
+
+
+class OwnerShardPlan(NamedTuple):
+    """Owner-shard chunking of the histogram (feature-group) axis for the
+    data-parallel reduce-scatter (data_parallel_tree_learner.cpp:174-186:
+    after ``Network::ReduceScatter`` each rank holds only ITS features'
+    global histograms).
+
+    chunk:      histogram rows owned per shard, ``ceil(G / n_shards)``
+                (G = EFB group count, or F without bundling) — the dp
+                grower's per-shard histogram carry is [L, chunk, B, 3]
+    fmax:       split-scan width per shard = max features owned by any
+                shard (> chunk only when EFB bundles several features
+                into one owned group)
+    shard_feat: [n_shards, fmax] int32 — GLOBAL feature id behind each
+                shard's local scan slot; -1 = padding (scan-masked)
+    """
+    chunk: int
+    fmax: int
+    shard_feat: np.ndarray
+
+    @property
+    def n_shards(self) -> int:
+        return self.shard_feat.shape[0]
+
+    def hist_bytes(self, num_leaves: int, padded_bins: int,
+                   scratch: int = 0) -> int:
+        """Per-shard histogram-state bytes at a leaf budget (f32 g/h/c)."""
+        return (num_leaves + scratch) * self.chunk * padded_bins * 3 * 4
+
+
+def owner_shard_plan(group_of: np.ndarray, n_shards: int) -> OwnerShardPlan:
+    """Partition the histogram axis (EFB groups; features when unbundled,
+    where ``group_of`` is the identity) into ``n_shards`` equal chunks and
+    map every owned group back to its global feature ids.  Host-side and
+    cheap — computed once per (feature count, mesh) pair."""
+    group_of = np.asarray(group_of, np.int64)
+    g = int(group_of.max()) + 1 if group_of.size else 1
+    chunk = -(-g // n_shards)
+    owned = [np.nonzero((group_of >= s * chunk)
+                        & (group_of < (s + 1) * chunk))[0]
+             for s in range(n_shards)]
+    fmax = max(1, max(len(o) for o in owned))
+    shard_feat = np.full((n_shards, fmax), -1, np.int32)
+    for s, o in enumerate(owned):
+        shard_feat[s, :len(o)] = o
+    return OwnerShardPlan(chunk=chunk, fmax=fmax, shard_feat=shard_feat)
 
 
 def init_distributed(coordinator_address: Optional[str] = None,

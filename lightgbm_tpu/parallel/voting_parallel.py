@@ -24,6 +24,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..grower import TreeArrays, make_grower
 from ..ops.split import SplitParams
+from ..utils.jax_compat import shard_map
 
 
 def _local_feature_gains(h: jax.Array, params: SplitParams,
@@ -95,7 +96,7 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         internal_weight=P(), internal_count=P(), leaf_depth=P(),
         leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P(), n_steps=P())
 
-    f = jax.shard_map(
+    f = shard_map(
         inner, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
         out_specs=out_specs, check_vma=False)

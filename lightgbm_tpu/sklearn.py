@@ -340,7 +340,11 @@ class LGBMClassifier(_LGBMClassifierBase, LGBMModel):
             counts = np.bincount(y.astype(int), minlength=self._n_classes)
             w_per_class = len(y) / (self._n_classes * np.maximum(counts, 1))
         else:
-            w_per_class = np.asarray([cw.get(c, 1.0)
+            # dict keys are the ORIGINAL label values ({1: w, 2: w} or
+            # strings), not encoded class indices — look up through the
+            # fitted classes (reference: compute_sample_weight keys by
+            # original label)
+            w_per_class = np.asarray([cw.get(self._classes[c], 1.0)
                                       for c in range(self._n_classes)])
         w = w_per_class[y.astype(int)]
         if sample_weight is not None:

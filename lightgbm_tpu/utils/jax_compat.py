@@ -1,0 +1,23 @@
+"""Version compatibility for JAX APIs the learners depend on.
+
+The distributed learners target the stable ``jax.shard_map`` entry point
+(with its ``check_vma`` argument); older JAX releases only ship
+``jax.experimental.shard_map.shard_map`` (whose equivalent argument is
+``check_rep``).  Every shard_map construction in this package routes
+through :func:`shard_map` below so the learners run on both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when available, else the experimental spelling
+    with ``check_vma`` translated to ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

@@ -94,9 +94,13 @@ def test_inprocess_train_lifecycle():
 
     out = np.zeros(n, np.float64)
     out_len = ctypes.c_int64()
+    # out_capacity is int64_t and sits PAST the 6 integer registers: a
+    # bare python int marshals as 4 bytes into an 8-byte stack slot whose
+    # upper half is whatever the caller left there — wrap it explicitly
     rc = lib.LGBM_TrainBoosterPredictForMat(
         bst, x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, f,
-        0, 0, -1, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        0, 0, -1, ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         ctypes.byref(out_len))
     assert rc == 0, lib.LGBM_TrainGetLastError()
     assert out_len.value == n
@@ -432,9 +436,11 @@ def test_dump_refit_binary_and_feature_names(tmp_path):
     assert rc == 0, lib.LGBM_TrainGetLastError()
     out = np.zeros(x.shape[0], np.float64)
     out_len = ctypes.c_int64()
+    # out_capacity is a BY-VALUE int64_t past the register args — see the
+    # marshalling note in test_inprocess_train_lifecycle
     assert lib.LGBM_TrainBoosterPredictForMat(
         b2, x2.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), x2.shape[0],
-        x2.shape[1], 0, 0, -1, len(out),
+        x2.shape[1], 0, 0, -1, ctypes.c_int64(len(out)),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         ctypes.byref(out_len)) == 0
     acc = ((out > 0.5) == y2).mean()

@@ -27,6 +27,64 @@ def bst():
     return lgb.train(dict(P), lgb.Dataset(x, label=y), num_boost_round=6)
 
 
+def test_predict_disable_shape_check(bst):
+    """ADVICE r5 #2: the feature-count mismatch raise honors
+    predict_disable_shape_check (config or predict-time override) and the
+    error message names the param (reference c_api predict contract)."""
+    x, _ = _data(n=64)
+    with pytest.raises(lgb.LightGBMError,
+                       match="predict_disable_shape_check"):
+        bst.predict(x[:, :4])
+    # narrower data with the check disabled: the missing tail zero-fills
+    # (the reference Predictor's zero-initialized row buffer) — identical
+    # to explicitly passing zeros for those features
+    p_narrow = bst.predict(x[:, :4], predict_disable_shape_check=True)
+    assert p_narrow.shape == (64,) and np.isfinite(p_narrow).all()
+    x_zeroed = np.concatenate([x[:, :4], np.zeros((64, x.shape[1] - 4))],
+                              axis=1)
+    np.testing.assert_allclose(p_narrow, bst.predict(x_zeroed), rtol=1e-12)
+    # wider data: extra columns are ignored -> identical to exact-width
+    x_wide = np.concatenate([x, np.ones((64, 2))], axis=1)
+    p_wide = bst.predict(x_wide, predict_disable_shape_check=True)
+    np.testing.assert_allclose(p_wide, bst.predict(x), rtol=1e-12)
+    # config-level flag works without the per-call override
+    x2, y2 = _data(n=500)
+    bst2 = lgb.train(dict(P, predict_disable_shape_check=True),
+                     lgb.Dataset(x2, label=y2), num_boost_round=2)
+    assert np.isfinite(bst2.predict(x2[:8, :4])).all()
+
+
+def test_train_fobj_positional_slot():
+    """ADVICE r5 #1: train() takes fobj in the reference positional slot
+    (between valid_names and feval), matching cv() — a reference-style
+    positional call must bind the custom objective correctly."""
+    x, y = _data(n=600)
+    ds = lgb.Dataset(x, label=y)
+
+    def fobj(preds, dsx):
+        lbl = np.asarray(dsx.get_label())
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - lbl, p * (1.0 - p)
+
+    feval_calls = []
+
+    def feval(score, dsx):
+        feval_calls.append(1)
+        return ("dummy", float(np.mean(score)), False)
+
+    vs = lgb.Dataset(x[:100], label=y[:100], reference=ds)
+    pc = dict(P, objective="custom")
+    # positional: (params, ds, rounds, valid_sets, valid_names, FOBJ, FEVAL)
+    bst = lgb.train(pc, ds, 4, [vs], ["v"], fobj, feval)
+    assert len(bst.trees) == 4
+    assert feval_calls, "positional feval was not used as the eval metric"
+    # keyword spelling unchanged
+    bst_kw = lgb.train(dict(pc), ds, 4, fobj=fobj)
+    np.testing.assert_allclose(bst.predict(x[:16], raw_score=True),
+                               bst_kw.predict(x[:16], raw_score=True),
+                               rtol=1e-6)
+
+
 def test_attr_roundtrip(bst):
     assert bst.attr("k") is None
     bst.set_attr(k="v", n=3)

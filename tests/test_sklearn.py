@@ -67,6 +67,43 @@ class TestClassifier:
         m.fit(x, y)
         assert np.isfinite(m.predict_proba(x[:10])).all()
 
+    def test_class_weight_dict_keys_original_labels(self, binary_data):
+        """ADVICE r5 #3: class_weight dict entries are keyed by the
+        ORIGINAL label values ({1,2}, strings), not the encoded class
+        index — a {1: .., 2: ..} dict on {1,2} labels must behave exactly
+        like the equivalent per-row sample_weight, not be dropped."""
+        x, y01 = binary_data
+        y = y01.astype(int) + 1                      # labels {1, 2}
+        cw = {1: 1.0, 2: 7.0}
+        m_cw = LGBMClassifier(n_estimators=8, num_leaves=7, max_bin=31,
+                              class_weight=cw)
+        m_cw.fit(x, y)
+        sw = np.where(y == 2, 7.0, 1.0)
+        m_sw = LGBMClassifier(n_estimators=8, num_leaves=7, max_bin=31)
+        m_sw.fit(x, y, sample_weight=sw)
+        np.testing.assert_allclose(m_cw.predict_proba(x[:200]),
+                                   m_sw.predict_proba(x[:200]),
+                                   rtol=1e-5, atol=1e-6)
+        # ...and an un-weighted fit must differ (the weights were applied)
+        m_un = LGBMClassifier(n_estimators=8, num_leaves=7, max_bin=31)
+        m_un.fit(x, y)
+        assert not np.allclose(m_cw.predict_proba(x[:200]),
+                               m_un.predict_proba(x[:200]))
+
+    def test_class_weight_dict_string_labels(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(800, 5)
+        y = np.where(x[:, 0] > 0, "pos", "neg")
+        m = LGBMClassifier(n_estimators=5, num_leaves=7, max_bin=31,
+                           class_weight={"pos": 3.0, "neg": 1.0})
+        m.fit(x, y)
+        sw = np.where(y == "pos", 3.0, 1.0)
+        m_sw = LGBMClassifier(n_estimators=5, num_leaves=7, max_bin=31)
+        m_sw.fit(x, y, sample_weight=sw)
+        np.testing.assert_allclose(m.predict_proba(x[:100]),
+                                   m_sw.predict_proba(x[:100]),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_eval_set_early_stopping(self, binary_data):
         x, y = binary_data
         m = LGBMClassifier(n_estimators=200, num_leaves=31, max_bin=63,

@@ -11,6 +11,40 @@ from lightgbm_tpu.utils import FunctionTimer, Log, global_timer, \
     register_log_callback
 
 
+class TestChooseParamValue:
+    """ADVICE r5 #4: the canonical key wins by PRESENCE — an explicitly
+    set None must not be overridden by an alias (the reference returns
+    immediately when main_param_name is in params)."""
+
+    def test_explicit_none_canonical_beats_alias(self):
+        from lightgbm_tpu.basic import _choose_param_value
+        out = _choose_param_value(
+            "num_iterations",
+            {"num_iterations": None, "n_estimators": 77}, 100)
+        assert out["num_iterations"] is None
+        assert "n_estimators" not in out
+
+    def test_alias_wins_over_default(self):
+        from lightgbm_tpu.basic import _choose_param_value
+        out = _choose_param_value("num_iterations",
+                                  {"n_estimators": 77}, 100)
+        assert out["num_iterations"] == 77
+        assert "n_estimators" not in out
+
+    def test_canonical_value_wins_over_alias(self):
+        from lightgbm_tpu.basic import _choose_param_value
+        out = _choose_param_value(
+            "num_iterations",
+            {"num_iterations": 5, "n_estimators": 77}, 100)
+        assert out["num_iterations"] == 5
+
+    def test_default_when_absent(self):
+        from lightgbm_tpu.basic import _choose_param_value
+        out = _choose_param_value("num_iterations", {"max_bin": 3}, 100)
+        assert out["num_iterations"] == 100
+        assert out["max_bin"] == 3
+
+
 class TestLog:
     def test_callback_sink(self):
         msgs = []

@@ -5,8 +5,23 @@ variant is applied R times IN-GRAPH (chained through a dummy dependency) and
 we report device-time-per-pass = wall / R.
 
 Run: python tools/bench_hist.py [n_rows] [R]
+
+--sharded: microbench the data-parallel histogram REDUCTION instead —
+owner-shard ``psum_scatter`` (each shard keeps [ceil(F/n), B, 3] of global
+histograms) vs the legacy full ``psum`` ([F, B, 3] replicated to every
+shard) at HIGGS (28) and Allstate (4228) feature widths over >= 2 shard
+counts.  Reports ms/pass and per-shard histogram bytes as JSON lines,
+with the measuring platform recorded in every record.  By default the
+bench runs on a virtual 8-device CPU mesh (this host's TPU is a single
+tunneled chip — no multi-device collective exists to measure);
+``--sharded-tpu`` keeps the real backend instead for hosts that DO have
+>= 2 accelerators, so recorded numbers are real ICI collectives there.
+Per-shard byte counts are platform-independent either way.
+
+Run: python tools/bench_hist.py --sharded [R] [--sharded-tpu]
 """
 
+import os
 import sys
 import time
 
@@ -14,9 +29,20 @@ import numpy as np
 
 sys.path.insert(0, ".")
 
+SHARDED_REAL = "--sharded-tpu" in sys.argv
+SHARDED = "--sharded" in sys.argv or SHARDED_REAL
+if SHARDED and not SHARDED_REAL \
+        and "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+if SHARDED and not SHARDED_REAL:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def amortized(make_one, R):
@@ -73,6 +99,68 @@ def hist_variant(block_rows, dtype, orient, num_bins, f):
         acc, _ = lax.scan(body, acc0, (binned_b, vals_b))
         return acc.reshape(f, num_bins, 3)
     return one
+
+
+def sharded_main():
+    """Owner-shard ``psum_scatter`` vs full ``psum`` of the reduced
+    histogram tensor (the dp learner's one heavy collective) — isolated
+    from the histogram build so the Allstate width stays benchable on a
+    CPU mesh.  Per-shard histogram bytes are the RESULT state each chip
+    must hold per leaf: chunk*B*3*4 (owner-shard) vs F*B*3*4 (psum)."""
+    import json
+
+    from lightgbm_tpu.parallel import make_mesh, owner_shard_plan
+    from lightgbm_tpu.parallel.data_parallel import owner_hist_reduce
+    from lightgbm_tpu.utils.jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    args = [a for a in sys.argv[1:] if not a.startswith("--sharded")]
+    R = int(args[0]) if args else 50
+    platform = jax.devices()[0].platform
+    B = 64
+    widths = (("higgs", 28), ("allstate", 4228))
+    shard_counts = [s for s in (2, 4, 8) if s <= len(jax.devices())]
+    assert len(shard_counts) >= 2, \
+        f"need >=2 benchable shard counts, have {len(jax.devices())} devices"
+
+    for n_shards in shard_counts:
+        mesh = make_mesh((n_shards,), ("data",),
+                         jax.devices()[:n_shards])
+        for name, f in widths:
+            plan = owner_shard_plan(np.arange(f), n_shards)
+            scatter_red = owner_hist_reduce("data", n_shards, plan.chunk)
+            full_red = lambda h: lax.psum(h, "data")
+            rng = np.random.RandomState(0)
+            h_local = rng.rand(f, B, 3).astype(np.float32)
+
+            def bench(red):
+                def body(h):
+                    def step(i, acc):
+                        r = red(h + i * jnp.float32(1e-9))
+                        return acc + lax.psum(r.sum(), "data")
+                    return lax.fori_loop(0, R, step, jnp.float32(0.0))
+                fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(),),
+                                       out_specs=P(), check_vma=False))
+                return timeit(fn, h_local) / R
+
+            t_scatter = bench(scatter_red)
+            t_psum = bench(full_red)
+            rec = {
+                "bench": "dp_hist_reduce", "platform": platform,
+                "width": name, "F": f, "B": B,
+                "n_shards": n_shards, "owner_chunk": plan.chunk,
+                "per_shard_hist_bytes_owner": plan.hist_bytes(1, B),
+                "per_shard_hist_bytes_psum": f * B * 3 * 4,
+                "ms_per_pass_psum_scatter": round(t_scatter * 1e3, 3),
+                "ms_per_pass_full_psum": round(t_psum * 1e3, 3),
+            }
+            print(json.dumps(rec), flush=True)
+            print(f"  shards={n_shards} {name}(F={f}): owner-shard "
+                  f"{rec['per_shard_hist_bytes_owner']/1e3:.1f} kB/shard "
+                  f"@ {rec['ms_per_pass_psum_scatter']:.3f} ms vs full-psum "
+                  f"{rec['per_shard_hist_bytes_psum']/1e3:.1f} kB/shard "
+                  f"@ {rec['ms_per_pass_full_psum']:.3f} ms",
+                  file=sys.stderr, flush=True)
 
 
 def main():
@@ -154,4 +242,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sharded_main() if SHARDED else main()
