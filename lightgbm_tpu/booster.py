@@ -517,7 +517,17 @@ class Booster:
             buf.write(b)
         buf.write("end of trees\n\n")
         buf.write("feature_importances:\n")
-        imp = self.feature_importance("gain")
+        # gains summed over the trees WRITTEN above ([t0:t1], like the
+        # reference's FeatureImportance over the saved range) and rounded
+        # through the same %g the tree blocks print: the importance
+        # section stays consistent with THIS file's trees, so
+        # save -> load -> save is byte-stable (subset saves included) and
+        # a crash+resume run (whose leading trees were parsed from a
+        # snapshot) sums exactly the gains a straight run's text records
+        imp = np.zeros(self._max_feature_idx + 1)
+        for t in self.trees[t0:t1]:
+            for i in range(t.num_nodes()):
+                imp[t.split_feature[i]] += float(f"{t.split_gain[i]:g}")
         order = np.argsort(-imp)
         for fi in order:
             if imp[fi] > 0:
@@ -551,8 +561,14 @@ class Booster:
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration, start_iteration))
+        """Write the model text atomically (temp file + ``os.replace``,
+        utils/resilience.py): a crash mid-save can never leave a
+        truncated model — the reference writes in place (gbdt_model_text
+        SaveModelToFile), which is exactly how the round-5 outage could
+        have corrupted its only snapshot."""
+        from .utils.resilience import atomic_write
+        atomic_write(filename,
+                     self.model_to_string(num_iteration, start_iteration))
         return self
 
     def dump_model(self, num_iteration: Optional[int] = None,

@@ -99,6 +99,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     cmp_op: List[Callable] = []
     enabled = [True]
     first_metric = [""]
+    warned_nonfinite = [False]
 
     def _metric_of(item) -> str:
         # cv 5-tuples carry '<set> <metric>' as the key
@@ -154,10 +155,23 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             _init(env)
         if not enabled[0]:
             return
+        import math
         for i, item in enumerate(env.evaluation_result_list):
             name, val = item[0], item[2]
             metric = _metric_of(item)
-            if best_score_list[i] is None or cmp_op[i](val, best_score[i]):
+            # a non-finite metric is NEVER an improvement: the reference
+            # (and this loop, before the fix) recorded the FIRST value
+            # unconditionally, so an early NaN/Inf became an unbeatable
+            # best score and poisoned the whole early-stopping run
+            finite = val is not None and math.isfinite(val)
+            if not finite and not warned_nonfinite[0]:
+                warned_nonfinite[0] = True
+                from .utils.log import Log
+                Log.warning(
+                    f"early stopping: non-finite value for {metric} "
+                    f"({val}); treated as no improvement")
+            if finite and (best_score_list[i] is None
+                           or cmp_op[i](val, best_score[i])):
                 best_score[i] = val
                 best_iter[i] = env.iteration
                 best_score_list[i] = list(env.evaluation_result_list)
@@ -166,17 +180,21 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             if name == "training" \
                     or (name == "cv_agg" and item[1].startswith("train ")):
                 continue
+            # best_score_list[i] stays None while every value so far was
+            # non-finite — report the current results in that case
+            bsl = best_score_list[i] if best_score_list[i] is not None \
+                else list(env.evaluation_result_list)
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:
                     print(f"Early stopping, best iteration is:\n"
                           f"[{best_iter[i] + 1}]\t" +
-                          "\t".join(_fmt_eval(r) for r in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
+                          "\t".join(_fmt_eval(r) for r in bsl))
+                raise EarlyStopException(best_iter[i], bsl)
             if env.iteration == env.end_iteration - 1:
                 if verbose:
                     print(f"Did not meet early stopping. Best iteration is:\n"
                           f"[{best_iter[i] + 1}]\t" +
-                          "\t".join(_fmt_eval(r) for r in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
+                          "\t".join(_fmt_eval(r) for r in bsl))
+                raise EarlyStopException(best_iter[i], bsl)
     _callback.order = 30
     return _callback

@@ -454,12 +454,17 @@ def network_init(machines: str, local_listen_port: int, listen_time_out: int,
     # matching instead.
     matches = [i for i, e in enumerate(entries)
                if e.endswith(f":{local_listen_port}")]
+    # the reference's listen_time_out is MINUTES (config.h time_out);
+    # it bounds the resilience layer's bring-up watchdog + retry deadline
+    timeout_s = max(0.0, float(listen_time_out)) * 60.0
     if len(matches) == 1:
         launch.init(coordinator_address=entries[0],
-                    num_processes=num_machines, process_id=matches[0])
+                    num_processes=num_machines, process_id=matches[0],
+                    timeout_s=timeout_s)
     else:
         launch.init(machines=",".join(entries),
-                    local_listen_port=local_listen_port)
+                    local_listen_port=local_listen_port,
+                    timeout_s=timeout_s)
 
 
 def network_free() -> None:

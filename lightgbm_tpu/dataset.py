@@ -1012,6 +1012,32 @@ class Dataset:
         sizes = np.diff(starts)
         self.metadata.set_group(sizes)
 
+    def fingerprint(self) -> str:
+        """Cheap content fingerprint for snapshot manifests (snapshot.py):
+        row count + f32 label/weight bytes, computed identically before
+        and after ``construct()`` so the manifest written mid-training
+        matches the check a resuming run performs on its yet-unbinned
+        dataset.  A guard against resuming onto the wrong data — not a
+        cryptographic identity of the feature matrix."""
+        import hashlib
+        h = hashlib.sha256()
+        lab = wgt = None
+        if self.metadata is not None:
+            lab, wgt = self.metadata.label, self.metadata.weight
+        if lab is None:
+            lab = getattr(self, "_label_in", None)
+        if wgt is None:
+            wgt = getattr(self, "_weight_in", None)
+        if lab is None:
+            h.update(b"unlabeled")
+        else:
+            lab = np.asarray(lab, np.float32).reshape(-1)
+            h.update(str(len(lab)).encode())
+            h.update(lab.tobytes())
+        if wgt is not None:
+            h.update(np.asarray(wgt, np.float32).reshape(-1).tobytes())
+        return h.hexdigest()[:16]
+
     # -- binary cache ----------------------------------------------------
     def save_binary(self, path: str) -> None:
         """Binary dataset cache (dataset.cpp SaveBinaryFile analog)."""
@@ -1067,11 +1093,18 @@ class Dataset:
                 [len(g) for g in self.efb.groups], np.int32)
             payload["efb_group_members"] = np.asarray(
                 [j for g in self.efb.groups for j in g], np.int32)
-        # write through a file object so the EXACT requested filename is
+        # write through a BYTES buffer so the EXACT requested filename is
         # honored (np.savez appends '.npz' to bare string paths — the
-        # reference C API contract saves to the caller's name verbatim)
-        with open(path, "wb") as f:
-            np.savez_compressed(f, **payload)
+        # reference C API contract saves to the caller's name verbatim),
+        # then atomically (temp + os.replace, utils/resilience.py): a
+        # crash mid-save can never leave a truncated binary cache that a
+        # later run would try to load
+        import io as _io
+        buf = _io.BytesIO()
+        np.savez_compressed(buf, **payload)
+        from .utils.resilience import atomic_write
+        # getbuffer(): hand atomic_write a view, not a second full copy
+        atomic_write(path, buf.getbuffer(), binary=True)
 
     @classmethod
     def load_binary(cls, path: str) -> "Dataset":

@@ -205,7 +205,11 @@ def train(params: dict, x: np.ndarray, y: Optional[np.ndarray] = None, *,
     machines = str(p.pop("machines", "") or "")
     port = int(p.pop("local_listen_port", 12400) or 12400)
     if machines and not getattr(launch.init, "_done", False):
-        launch.init(machines=machines, local_listen_port=port)
+        # honor the fault-tolerance bring-up params (config.py) here the
+        # same way GBDTModel._resolve_mesh does for the mesh claim
+        launch.init(machines=machines, local_listen_port=port,
+                    retries=int(p.get("dist_init_retries", 2)),
+                    timeout_s=float(p.get("dist_init_timeout_s", 300.0)))
 
     import jax
     pc = jax.process_count()

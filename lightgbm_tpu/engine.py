@@ -36,9 +36,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
     ``fobj`` sits in the reference's positional slot — between
     ``valid_names`` and ``feval`` (v3.3.2 engine.py:25), matching ``cv``
     — so reference-style positional calls bind the custom objective and
-    custom metric to the right parameters."""
+    custom metric to the right parameters.
+
+    ``resume=true`` in ``params`` auto-resumes from the newest VALID
+    snapshot of ``output_model`` (manifest params-signature + data
+    fingerprint must match, snapshot.py) through this function's
+    init_model path; train-straight and crash-then-resume produce
+    byte-identical model text (docs/Fault-Tolerance.md)."""
     params = dict(params or {})
+    # resume is a run-control switch, not a model hyperparameter: strip
+    # it (and its aliases) from the params that reach the Booster so the
+    # saved parameters section is identical between a straight run and a
+    # crash+resume run
+    from .config import _ALIASES, _coerce
+    resume_req = False
+    for k in list(params):
+        if _ALIASES.get(k, k) == "resume":
+            resume_req = bool(_coerce("resume", bool, params.pop(k)))
     cfg = Config(params)
+    cfg.resume = resume_req
     from .config import canonical_params
     if "num_iterations" in canonical_params(params):
         # any num_iterations alias in params overrides the keyword
@@ -60,13 +76,40 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # continued training: init_model predictions become the init score
     # (application.cpp:88-94 input_model pattern)
     prev_booster = None
+    resume_start = 0
+    snap_sig = None
+    if cfg.snapshot_freq > 0 or resume_req:
+        from .snapshot import params_signature
+        snap_sig = params_signature(params)
     if init_model is not None:
         prev_booster = (Booster(model_file=init_model)
                         if isinstance(init_model, str) else init_model)
         raw = prev_booster.predict(_dataset_raw(train_set), raw_score=True)
         train_set.set_init_score(np.asarray(raw, np.float64))
+    elif resume_req:
+        from .snapshot import find_latest_snapshot
+        from .utils.log import Log
+        found = find_latest_snapshot(cfg.output_model, snap_sig, train_set)
+        if found is None:
+            Log.info("resume=true but no valid snapshot found for "
+                     f"{cfg.output_model!r}; training from scratch")
+        else:
+            resume_start, snap_path, snap_score = found
+            prev_booster = Booster(model_file=snap_path)
+            # the saved f32 training score IS the device state at the
+            # snapshot — feeding it back through the init_model path
+            # continues training bit-exactly where the crash hit (a
+            # re-prediction of the snapshot model would differ in the
+            # last ulp and change the trees grown after the resume)
+            train_set.set_init_score(np.asarray(snap_score, np.float64))
+            Log.info(f"auto-resume: continuing from {snap_path} "
+                     f"(iteration {resume_start})")
 
     booster = Booster(params=params, train_set=train_set)
+    if resume_start and booster._model is not None:
+        # align iteration-keyed RNG streams (bagging epochs, GOSS keys,
+        # feature-fraction draws) with the straight run
+        booster._model.set_resume_state(resume_start)
     train_eval_name = None
     if valid_sets:
         names = valid_names or [
@@ -103,7 +146,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # on-device chunks of ``fused_chunk`` — one host sync per chunk
     # instead of ~5 per iteration (decisive on a tunneled chip; see
     # PROFILE.md).  Any remainder falls through to the per-iter loop.
-    start_round = 0
+    start_round = resume_start
     chunk_stopped = False
     chunk = cfg.fused_chunk
     if (chunk > 1 and fobj is None and not cbs
@@ -114,7 +157,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
             and booster.supports_fused()):
         while num_boost_round - start_round >= chunk and not chunk_stopped:
             chunk_stopped = booster.update_chunk(chunk)
-            start_round = booster.current_iteration
+            # current_iteration counts only THIS booster's iterations;
+            # a resumed run's global round index carries the offset
+            start_round = resume_start + booster.current_iteration
 
     for i in range(start_round, num_boost_round if not chunk_stopped else 0):
         env = CallbackEnv(model=booster, params=params, iteration=i,
@@ -128,8 +173,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
             Log.info(f"{_time.time() - t_start:.6f} seconds elapsed, "
                      f"finished iteration {i + 1}")
         if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
-            # periodic snapshot (gbdt.cpp:279-284 snapshot_freq)
-            booster.save_model(f"{cfg.output_model}.snapshot_iter_{i + 1}")
+            # periodic crash-safe snapshot: model + f32 score state +
+            # manifest, each written atomically; prunes to snapshot_keep
+            # (gbdt.cpp:279-284 snapshot_freq + snapshot.py)
+            from .snapshot import write_snapshot
+            try:
+                write_snapshot(booster, prev_booster, cfg, i + 1,
+                               snap_sig, train_set)
+            except Exception as e:
+                # a full disk (or an injected write failure) must not
+                # kill a long training run — skip the snapshot, loudly
+                from .utils.log import Log
+                Log.warning(f"snapshot at iteration {i + 1} failed "
+                            f"({e}); training continues")
         evals = []
         if booster._valid_names or cfg.is_provide_training_metric \
                 or train_eval_name is not None:

@@ -27,6 +27,11 @@ from ..ops.split import SplitParams
 from ..predict_device import add_tree_score, round_up_pow2, traverse_tree_binned
 from ..tree_model import Tree
 
+# finite_check_policy=clamp replaces non-finite gradients/hessians/leaf
+# outputs with 0 (NaN) or ±this bound (infinities) — large enough not to
+# distort healthy training, small enough that squares stay in f32 range
+_FINITE_CLAMP = 1e30
+
 
 class _DeviceTree:
     """Per-tree device arrays for fast binned traversal."""
@@ -90,6 +95,12 @@ class GBDTModel:
         self.num_class = config.num_model_per_iteration
         self.learning_rate = config.learning_rate
         self.iter_ = 0
+        # iteration-keyed RNG/guard streams (bagging epochs, GOSS keys,
+        # extra_trees/bynode draws, finite-check cadence) run on
+        # iter_ + this offset so a crash+resume run replays the SAME
+        # per-iteration randomness as the straight run (snapshot resume,
+        # engine.py; set via set_resume_state)
+        self._iter_rng_offset = 0
 
         ds = self.train_set
         self.num_data = ds.num_data
@@ -650,11 +661,38 @@ class GBDTModel:
         reference's machine list, SURVEY.md §2.5).  Size precedence:
         ``mesh_shape`` > ``num_machines`` > all visible devices.  Returns
         None (serial fallback, with a warning) on a single device —
-        the reference's num_machines=1 degenerate case."""
+        the reference's num_machines=1 degenerate case.
+
+        The device claim itself (jax backend init — the call that wedged
+        for ~10 h in round 5) runs under the resilience layer: watchdog
+        stack dumps at ``dist_init_timeout_s``, ``dist_init_retries``
+        jittered-backoff retries for classified-transient errors, and an
+        optional graceful degradation to the serial learner
+        (``dist_fallback_serial``) when bring-up exhausts its retries."""
         import jax
         from ..parallel import make_mesh
+        from ..utils import faultinject
         from ..utils.log import Log
-        devs = jax.devices()
+        from ..utils.resilience import RetryPolicy, Watchdog, retry_call
+
+        def _claim():
+            faultinject.check("device_claim")
+            return jax.devices()
+
+        timeout = config.dist_init_timeout_s
+        policy = RetryPolicy.for_bringup(config.dist_init_retries, timeout)
+        try:
+            with Watchdog(timeout, label="device claim"):
+                devs = retry_call(_claim, policy=policy,
+                                  label="device claim")
+        except Exception as e:
+            if config.dist_fallback_serial:
+                Log.warning(
+                    f"multi-chip bring-up failed after "
+                    f"{policy.max_attempts} attempt(s) ({e}); falling back "
+                    "to the serial learner (dist_fallback_serial=true)")
+                return None
+            raise
         if config.mesh_shape and len(config.mesh_shape) > 1:
             # the tree learners shard exactly one axis (rows OR features);
             # a multi-dim mesh has no meaning here, so reject it loudly
@@ -891,7 +929,7 @@ class GBDTModel:
             thresh = -jnp.sort(-absg)[top_k - 1]
         is_top = absg >= thresh
         if it is None:
-            it = self.iter_
+            it = self.iter_ + self._iter_rng_offset
         key = jax.random.PRNGKey(cfg.bagging_seed + it)
         if self._pc > 1 and not multi and self._dist != "feature":
             # multi-process WITHOUT the mesh data-parallel bookkeeping
@@ -923,6 +961,19 @@ class GBDTModel:
     def _score_for_gradients(self) -> jax.Array:
         return self.score
 
+    def set_resume_state(self, start_iteration: int) -> None:
+        """Align all iteration-keyed state with a straight run that
+        already trained ``start_iteration`` iterations (snapshot
+        auto-resume, engine.py): iteration-indexed RNG keys (bagging
+        epochs, GOSS, extra_trees/bynode, finite-check cadence) shift by
+        the offset, and the stateful feature-fraction host RNG is
+        fast-forwarded by redrawing the consumed masks — so crash+resume
+        trains byte-identical trees to never-crashing."""
+        self._iter_rng_offset = int(start_iteration)
+        if self.config.feature_fraction < 1.0:
+            for _ in range(int(start_iteration)):
+                self._feature_mask()
+
     # -- fused multi-iteration path (the tunnel-latency killer) ------------
     def _fusable_config(self) -> bool:
         """Whether this model/objective/sampling combination has fused-path
@@ -949,8 +1000,20 @@ class GBDTModel:
         tunneled chip, so the per-iteration path pays ~335 ms/iter of pure
         latency; the reference's cuda_exp learner syncs once per TREE
         (cuda_single_gpu_tree_learner.cpp:108-232) — this syncs once per
-        CHUNK of trees."""
-        return self.config.fused_chunk > 1 and self._fusable_config()
+        CHUNK of trees.
+
+        Active fault injection (utils/faultinject.py) forces the
+        per-iteration path: host-side injection sites cannot fire inside
+        a fused device program.  Path choice only — numerics are still
+        governed by ``_fusable_config``, so injected and clean runs train
+        identical models."""
+        return (self.config.fused_chunk > 1 and self._fusable_config()
+                and not self._faults_active())
+
+    @staticmethod
+    def _faults_active() -> bool:
+        from ..utils import faultinject
+        return faultinject.enabled()
 
     def _fused_chunk_fn(self):
         fn = self._fused_cache.get("chunk")
@@ -977,6 +1040,8 @@ class GBDTModel:
             use_goss = self._goss
             use_bag = self._bagging_active and not use_goss
             ic = self._ic_grow
+            fin_freq = cfg.finite_check_freq
+            fin_policy = cfg.finite_check_policy
 
             use_cegb = self._cegb_state is not None
             nf = self.num_features
@@ -985,6 +1050,12 @@ class GBDTModel:
                 score, dead, cuse = carry
                 fmask, it = xs
                 g, h = obj.get_gradients(score[:, 0])
+                if fin_freq > 0 and fin_policy == "clamp":
+                    # clamp is sync-free, so it applies every iteration
+                    g = jnp.nan_to_num(g, nan=0.0, posinf=_FINITE_CLAMP,
+                                       neginf=-_FINITE_CLAMP)
+                    h = jnp.nan_to_num(h, nan=0.0, posinf=_FINITE_CLAMP,
+                                       neginf=0.0)
                 if use_goss:
                     w = self._goss_vals(g, h, it)
                 elif use_bag:
@@ -1008,29 +1079,67 @@ class GBDTModel:
                         .at[arrays.split_feature].add(
                             node_on.astype(jnp.int32))
                     cuse = cuse | (marks > 0)
-                lv = arrays.leaf_value * lr
+                if fin_freq > 0 and fin_policy == "clamp":
+                    # clamp BEFORE shrinkage, exactly where the per-iter
+                    # path clamps its host leaf_values — an inf leaf must
+                    # become ±bound*lr on both paths
+                    lv = jnp.nan_to_num(
+                        arrays.leaf_value, nan=0.0, posinf=_FINITE_CLAMP,
+                        neginf=-_FINITE_CLAMP) * lr
+                else:
+                    lv = arrays.leaf_value * lr
+                # finite guard (fused form): ONE fused isfinite reduction
+                # over grad/hess and the new tree's leaf outputs at check
+                # iterations; the per-iteration flag ships with the tree
+                # records, so the whole chunk still costs a single host
+                # sync (the policy engages host-side in train_chunk)
+                if fin_freq > 0 and fin_policy != "clamp":
+                    check_now = ((it + 1) % fin_freq) == 0
+                    fin = (jnp.isfinite(g).all() & jnp.isfinite(h).all()
+                           & jnp.isfinite(lv).all())
+                    bad = check_now & ~fin
+                else:
+                    bad = jnp.bool_(False)
                 # per-iteration semantics stop training at the FIRST
                 # no-split tree (gbdt.cpp "no more leaves..."); once dead,
                 # later scan iterations must contribute nothing, even if a
                 # different feature mask could have split (the host loop
                 # discards their tree records)
-                ok = jnp.where(dead, 0.0,
+                ok = jnp.where(dead | bad, 0.0,
                                (arrays.num_leaves > 1).astype(jnp.float32))
-                dead = dead | (arrays.num_leaves <= 1)
-                delta = jnp.take(lv, arrays.leaf_of_row) * ok
+                if fin_freq > 0 and fin_policy == "raise":
+                    # halt at the first tripped check: later iterations
+                    # contribute nothing, so the host can raise at the
+                    # flagged iteration with a consistent score/model
+                    dead = dead | (arrays.num_leaves <= 1) | bad
+                else:
+                    # skip_iter: the flagged iteration contributes a zero
+                    # stump; a NaN-induced natural stump must NOT end
+                    # training
+                    dead = dead | ((arrays.num_leaves <= 1) & ~bad)
+                delta = jnp.where(ok > 0.0,
+                                  jnp.take(lv, arrays.leaf_of_row), 0.0)
                 score = score.at[:, 0].add(delta)
+                if fin_freq > 0 and fin_policy == "skip_iter":
+                    # a tripped check heals the score carry too: a NaN
+                    # that slipped in at an UNCHECKED iteration (freq>1)
+                    # would otherwise re-poison every later gradient and
+                    # the guard would skip forever
+                    score = jnp.where(bad, jnp.nan_to_num(
+                        score, nan=0.0, posinf=_FINITE_CLAMP,
+                        neginf=-_FINITE_CLAMP), score)
                 # keep the scan outputs tree-sized: drop the [N] row->leaf
                 # vector, ship shrunk leaf values
                 out = arrays._replace(leaf_of_row=jnp.zeros((), jnp.int32),
                                       leaf_value=lv)
-                return (score, dead, cuse), out
+                return (score, dead, cuse), (out, bad)
 
             @functools.partial(jax.jit, donate_argnums=(0,))
             def chunk(score, fmasks, iters, cuse0):
-                (score, _, _), out = jax.lax.scan(
+                (score, _, _), (out, bad) = jax.lax.scan(
                     one_iter, (score, jnp.bool_(False), cuse0),
                     (fmasks, iters))
-                return score, out
+                return score, out, bad
 
             fn = self._fused_cache["chunk"] = chunk
         return fn
@@ -1064,18 +1173,48 @@ class GBDTModel:
                 np.stack([self._feature_mask() for _ in range(k)]))
         else:
             fmasks = jnp.ones((k, self.num_features), bool)
-        iters = jnp.arange(start_iter, start_iter + k, dtype=jnp.int32)
+        it0 = start_iter + self._iter_rng_offset
+        iters = jnp.arange(it0, it0 + k, dtype=jnp.int32)
         cuse0 = jnp.asarray(self._cegb_state.used) \
             if self._cegb_state is not None \
             else jnp.zeros(1, bool)
-        self.score, stacked = chunk(self.score, fmasks, iters, cuse0)
-        host = jax.device_get(stacked)          # the one sync per chunk
+        self.score, stacked, bad_flags = chunk(self.score, fmasks, iters,
+                                               cuse0)
+        # the one sync per chunk (tree records + finite-guard flags)
+        host, bad_host = jax.device_get((stacked, bad_flags))
 
         lr = self.learning_rate
         stopped = False
         for j in range(k):
             tj = TreeArrays(*(np.asarray(fld[j]) for fld in host))
             nl = int(tj.num_leaves)
+            if bool(bad_host[j]):
+                from ..utils.log import Log
+                msg = ("non-finite gradient/hessian or leaf output "
+                       f"detected at iteration {it0 + j + 1} "
+                       f"(finite_check_freq={cfg.finite_check_freq})")
+                if cfg.finite_check_policy == "raise":
+                    from ..basic import LightGBMError
+                    raise LightGBMError(
+                        msg + "; aborting (finite_check_policy=raise)")
+                # skip_iter: the iteration already contributed nothing
+                # in-graph; record a zero stump so iteration counts and
+                # model text match the per-iteration path exactly
+                Log.warning(msg + "; iteration contributes nothing "
+                                  "(finite_check_policy=skip_iter)")
+                self.step_counts.append(int(tj.n_steps))
+                ht = Tree(1)
+                ht.shrinkage = lr
+                ht.leaf_value = np.asarray(
+                    [init0 if (start_iter == 0 and j == 0) else 0.0],
+                    np.float64)
+                self.models.append(ht)
+                dev_arrays = TreeArrays(*(fld[j] for fld in stacked))
+                self.device_trees.append(_DeviceTree(
+                    dev_arrays, jnp.zeros(cfg.num_leaves, jnp.float32), 1))
+                self.tree_weights.append(1.0)
+                self.iter_ += 1
+                continue
             self.step_counts.append(int(tj.n_steps))
             lvj = np.asarray(tj.leaf_value, np.float64).copy()
             if self._cegb_state is not None and nl > 1:
@@ -1142,11 +1281,38 @@ class GBDTModel:
             g_all = g_all.reshape(self.num_data, self.num_class)
             h_all = h_all.reshape(self.num_data, self.num_class)
 
-        bag = self._bagging_w(jnp.int32(self.iter_)) \
+        it_global = self.iter_ + self._iter_rng_offset
+        # fault injection: gradient poisoning at iteration k (the
+        # 'nan_grads' site's hit index IS the iteration number)
+        from ..utils import faultinject
+        if faultinject.enabled() and faultinject.fires("nan_grads"):
+            g_all = g_all.at[0].set(jnp.nan)
+            h_all = h_all.at[0].set(jnp.nan)
+
+        # finite guard (gbdt.cpp has none; one NaN batch silently poisons
+        # a million-iteration model): every finite_check_freq iterations,
+        # one fused isfinite scalar over grad/hess — fetched together
+        # with this iteration's leaf-output check below, so the guard
+        # costs a single amortized scalar sync.  clamp is sync-free and
+        # therefore applies every iteration.
+        fin_freq = cfg.finite_check_freq
+        fin_policy = cfg.finite_check_policy
+        fin_check = fin_freq > 0 and (it_global + 1) % fin_freq == 0
+        gh_ok = None
+        if fin_freq > 0 and fin_policy == "clamp":
+            g_all = jnp.nan_to_num(g_all, nan=0.0, posinf=_FINITE_CLAMP,
+                                   neginf=-_FINITE_CLAMP)
+            h_all = jnp.nan_to_num(h_all, nan=0.0, posinf=_FINITE_CLAMP,
+                                   neginf=0.0)
+        elif fin_check:
+            gh_ok = jnp.isfinite(g_all).all() & jnp.isfinite(h_all).all()
+
+        bag = self._bagging_w(jnp.int32(it_global)) \
             if self._bagging_active and not self._goss else None
         fmask = jnp.asarray(self._feature_mask())
 
         stopped = True
+        heal_score = False
         iter_trees: List[Tree] = []
         iter_state = {"leaf_of_rows": [], "leaf_values": [], "trees": [],
                       "train_deltas": [], "valid_deltas": []}
@@ -1173,7 +1339,7 @@ class GBDTModel:
                         and self._dist is None:
                     # per-iteration extra_trees/bynode key component (the
                     # partitioned learner's host RNG advances statefully)
-                    gkw["rng_iter"] = jnp.int32(self.iter_)
+                    gkw["rng_iter"] = jnp.int32(it_global)
                 if self._cegb_state is not None and self._dist is None:
                     # CEGB on the masked grower: cross-tree used-feature
                     # state goes in as an argument; the in-tree updates
@@ -1219,7 +1385,37 @@ class GBDTModel:
                 self._cegb_state.used[
                     np.asarray(host.split_feature)[:nl - 1]] = True
             leaf_values = np.asarray(host.leaf_value, np.float64).copy()
-            if nl <= 1:
+            skip_tree = False
+            if fin_freq > 0 and fin_policy == "clamp":
+                leaf_values = np.nan_to_num(
+                    leaf_values, nan=0.0, posinf=_FINITE_CLAMP,
+                    neginf=-_FINITE_CLAMP)
+            elif fin_check:
+                fin_ok = bool(np.isfinite(leaf_values[:max(nl, 1)]).all())
+                if fin_ok and gh_ok is not None:
+                    fin_ok = bool(jax.device_get(gh_ok))
+                    gh_ok = None      # the one scalar sync per check
+                if not fin_ok:
+                    msg = ("non-finite gradient/hessian or leaf output "
+                           f"detected at iteration {it_global + 1} "
+                           f"(finite_check_freq={fin_freq})")
+                    if fin_policy == "raise":
+                        from ..basic import LightGBMError
+                        raise LightGBMError(
+                            msg + "; aborting (finite_check_policy=raise)")
+                    from ..utils.log import Log
+                    Log.warning(msg + "; iteration contributes nothing "
+                                      "(finite_check_policy=skip_iter)")
+                    skip_tree = True
+            if skip_tree:
+                # the iteration contributes a zero stump; training
+                # continues (a NaN-induced stump must not end the run)
+                nl = 1
+                host = host._replace(num_leaves=np.int32(1))
+                leaf_values[:] = 0.0
+                stopped = False
+                heal_score = True
+            elif nl <= 1:
                 leaf_values[:] = 0.0  # stump contributes nothing (gbdt.cpp warn)
             else:
                 stopped = False
@@ -1255,6 +1451,11 @@ class GBDTModel:
             # never reads leaf_of_row)
             ht = Tree.from_arrays(host, self.train_set.used_features,
                                   self.train_set.bin_mappers)
+            if skip_tree:
+                # the stump's leaf stats came from a NaN-poisoned pass —
+                # zero them so the serialized tree is clean
+                ht.leaf_weight[:] = 0.0
+                ht.leaf_count[:] = 0
             ht.internal_value = ht.internal_value * shrinkage
             ht.shrinkage = shrinkage
             iter_trees.append(ht)
@@ -1308,6 +1509,15 @@ class GBDTModel:
                                        vscore.at[:, k].add(vd))
             iter_state["valid_deltas"].append(vdeltas)
 
+        if heal_score:
+            # a tripped skip_iter check heals the score carry too: a NaN
+            # that slipped in at an UNCHECKED iteration (freq>1) would
+            # otherwise re-poison every later gradient and the guard
+            # would skip forever (same sanitization point as the fused
+            # path — the two stay byte-identical)
+            self.score = jnp.nan_to_num(self.score, nan=0.0,
+                                        posinf=_FINITE_CLAMP,
+                                        neginf=-_FINITE_CLAMP)
         self.models.extend(iter_trees)
         self._last_iter_state = iter_state
         self.iter_ += 1

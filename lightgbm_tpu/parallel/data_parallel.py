@@ -123,9 +123,30 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
               max_depth=max_depth, block_rows=block_rows, axis=axis,
               efb=efb, split_batch=split_batch, mono=mono,
               mono_penalty=mono_penalty, sparse=sparse)
-    if owner_shard:
-        return _make_dp_owner_grower(mesh, **kw)
-    return _make_dp_psum_grower(mesh, **kw)
+    inner = _make_dp_owner_grower(mesh, **kw) if owner_shard \
+        else _make_dp_psum_grower(mesh, **kw)
+
+    return _CollectiveGate(inner)
+
+
+class _CollectiveGate:
+    """Callable pass-through hosting the 'collective' fault-injection
+    site (utils/faultinject.py) at the dispatch of the cross-shard
+    histogram reduction program — one dict-empty check when inactive.
+    Attribute access (e.g. the owner-shard ``plan``, attached to the
+    inner grower lazily at first trace) delegates to the wrapped
+    grower."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __call__(self, *args, **kwargs):
+        from ..utils import faultinject
+        faultinject.check("collective")
+        return self._inner(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,

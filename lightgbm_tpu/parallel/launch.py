@@ -51,17 +51,31 @@ def init(coordinator_address: Optional[str] = None,
          num_processes: Optional[int] = None,
          process_id: Optional[int] = None,
          machines: Optional[str] = None,
-         local_listen_port: int = 12400) -> None:
+         local_listen_port: int = 12400,
+         retries: int = 2,
+         timeout_s: float = 300.0) -> None:
     """Bring up jax.distributed (LGBM_NetworkInit / dask._train machinery
     analog).  ``machines`` accepts the reference's "ip1:port1,ip2:port2"
     parameter format (config.h machines / dask.py:700) — the first entry
     becomes the coordinator; rank is inferred by matching the local host.
     On TPU pods, call with no arguments: everything is auto-detected.
 
+    The initialize attempt runs under the resilience layer
+    (utils/resilience.py — the reference's socket linker retries its
+    connect loop the same way, network/linkers_socket.cpp):
+    ``retries`` jittered-backoff re-attempts for classified-transient
+    failures (UNAVAILABLE, timeouts, refused connections), a hard
+    ``timeout_s`` deadline, and a faulthandler watchdog so a wedged
+    bring-up dumps stacks instead of hanging silently.  Fatal errors
+    (bad arguments) surface immediately.
+
     MUST run before any other JAX call (jax.distributed.initialize refuses
     to run once XLA backends exist) — so no jax.* probing happens here
     before the initialize attempt."""
     import jax
+
+    from ..utils import faultinject
+    from ..utils.resilience import RetryPolicy, Watchdog, retry_call
 
     if getattr(init, "_done", False):
         return
@@ -84,13 +98,20 @@ def init(coordinator_address: Optional[str] = None,
             if process_id is None:
                 raise ValueError(
                     f"local host not found in machines={machines!r}")
-    try:
+    def _bring_up():
+        faultinject.check("device_claim")
         if coordinator_address is not None:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes, process_id=process_id)
         else:
             jax.distributed.initialize()
+
+    policy = RetryPolicy.for_bringup(retries, timeout_s)
+    try:
+        with Watchdog(timeout_s, label="jax.distributed bring-up"):
+            retry_call(_bring_up, policy=policy,
+                       label="jax.distributed bring-up")
         init._done = True
     except (RuntimeError, ValueError) as e:
         if coordinator_address is not None:

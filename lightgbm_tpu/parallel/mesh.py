@@ -95,12 +95,31 @@ def owner_shard_plan(group_of: np.ndarray, n_shards: int) -> OwnerShardPlan:
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     retries: int = 2,
+                     timeout_s: float = 300.0) -> None:
     """Multi-host bring-up (jax.distributed) — the ``Network::Init`` /
     ``LGBM_NetworkInit`` analog (network.cpp, c_api.h:1350).  On TPU pods
-    arguments are auto-detected from the runtime environment."""
+    arguments are auto-detected from the runtime environment.
+
+    Runs under the resilience layer (utils/resilience.py): transient
+    bring-up failures are retried ``retries`` times with jittered
+    backoff under a ``timeout_s`` deadline, and a faulthandler watchdog
+    dumps all-thread stacks if the blocking initialize wedges (the
+    round-5 failure mode: a 10 h silent hang)."""
+    from ..utils import faultinject
+    from ..utils.resilience import RetryPolicy, Watchdog, retry_call
+
     kwargs = {}
     if coordinator_address is not None:
         kwargs.update(coordinator_address=coordinator_address,
                       num_processes=num_processes, process_id=process_id)
-    jax.distributed.initialize(**kwargs)
+
+    def _bring_up():
+        faultinject.check("device_claim")
+        jax.distributed.initialize(**kwargs)
+
+    policy = RetryPolicy.for_bringup(retries, timeout_s)
+    with Watchdog(timeout_s, label="jax.distributed bring-up"):
+        retry_call(_bring_up, policy=policy,
+                   label="jax.distributed bring-up")

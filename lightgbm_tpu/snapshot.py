@@ -1,0 +1,183 @@
+"""Crash-safe training snapshots + auto-resume.
+
+The reference's ``snapshot_freq`` (gbdt.cpp:279-284) writes the model
+text mid-training but never reads it back — resuming means the operator
+hand-wiring ``input_model``.  After the round-5 outage (10 h tunnel
+wedge, no way to continue the run) this module closes the loop:
+
+- :func:`write_snapshot` — the model text, a ``.state.npz`` sidecar (the
+  f32 training score, so a resumed run continues from the EXACT device
+  state rather than a re-predicted approximation of it) and a
+  ``.manifest.json`` sidecar (iteration, params signature, data
+  fingerprint).  All three go through ``resilience.atomic_write``; the
+  manifest is written LAST, so its presence marks a complete snapshot —
+  a crash mid-snapshot leaves the previous snapshot as the newest valid
+  one.  Old snapshots are pruned to ``snapshot_keep``.
+- :func:`find_latest_snapshot` — newest snapshot whose manifest parses,
+  whose params signature matches the current run (so a changed learning
+  rate can't silently splice into an old model), and whose data
+  fingerprint matches the current dataset.  Invalid/mismatched
+  candidates are warned about and skipped in favor of older ones.
+- :func:`params_signature` — canonicalized-params hash with
+  resume-control keys (``resume``, ``snapshot_freq`` …) excluded, so
+  toggling snapshot bookkeeping never invalidates a snapshot.
+
+``engine.train`` consumes these when ``resume=true``: the found model
+feeds the existing ``init_model`` continued-training path, the state
+score becomes the dataset's init score, and the booster's
+iteration-keyed RNG streams are fast-forwarded
+(``GBDTModel.set_resume_state``) — train-straight and crash-then-resume
+produce byte-identical model text (tests/test_fault_tolerance.py).
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import io
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .utils.log import Log
+from .utils.resilience import atomic_write
+
+_FORMAT = 1
+
+# params that control snapshot/resume bookkeeping rather than the trained
+# model — excluded from the signature so (a) toggling them between runs
+# never invalidates a snapshot and (b) resuming with a LARGER
+# num_iterations ("train 1M more") is allowed
+_VOLATILE = {
+    "resume", "snapshot_freq", "snapshot_keep", "num_iterations",
+    "output_model", "input_model", "verbosity", "task", "data", "valid",
+    "config", "machines", "machine_list_filename",
+    # bring-up resilience knobs never affect the trained model, and
+    # raising them is the NATURAL response to the crash being resumed
+    # from — they must not invalidate the snapshot
+    "dist_init_retries", "dist_init_timeout_s", "dist_fallback_serial",
+}
+
+
+def params_signature(params: Dict[str, Any]) -> str:
+    """Stable hash of the training-relevant parameter surface."""
+    from .config import canonical_params
+    cp = canonical_params(params)
+    for k in _VOLATILE:
+        cp.pop(k, None)
+    blob = json.dumps(cp, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _snapshot_path(output_model: str, iteration: int) -> str:
+    return f"{output_model}.snapshot_iter_{iteration}"
+
+
+def _list_snapshots(output_model: str):
+    """[(iteration, model_path)] for existing snapshot MODEL files,
+    newest first.  Sidecars and atomic-write temp debris are ignored."""
+    pat = re.compile(re.escape(os.path.basename(output_model))
+                     + r"\.snapshot_iter_(\d+)$")
+    out = []
+    for path in glob.glob(glob.escape(output_model) + ".snapshot_iter_*"):
+        m = pat.match(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    out.sort(reverse=True)
+    return out
+
+
+def write_snapshot(booster, prev_booster, cfg, iteration: int,
+                   signature: str, train_set) -> None:
+    """Persist one snapshot (model + state + manifest, in that order)
+    and prune to ``cfg.snapshot_keep``.  ``prev_booster`` (continued
+    training / an earlier resume) contributes its leading trees so the
+    snapshot is the FULL model, not just this run's suffix."""
+    base = _snapshot_path(cfg.output_model, iteration)
+    trees, weights = booster.trees, booster.tree_weights
+    if prev_booster is not None:
+        booster.trees = prev_booster.trees + trees
+        booster.tree_weights = list(prev_booster.tree_weights) + list(weights)
+    try:
+        text = booster.model_to_string()
+    finally:
+        booster.trees, booster.tree_weights = trees, weights
+    score = np.asarray(booster._model.score, np.float32)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, score=score)
+    manifest = {
+        "format": _FORMAT,
+        "iteration": int(iteration),
+        "params_signature": signature,
+        "data_fingerprint": train_set.fingerprint(),
+        "num_data": int(score.shape[0]),
+        "num_class": int(score.shape[1]) if score.ndim > 1 else 1,
+        "model_file": os.path.basename(base),
+        "state_file": os.path.basename(base) + ".state.npz",
+    }
+    atomic_write(base, text)
+    atomic_write(base + ".state.npz", buf.getvalue(), binary=True)
+    # manifest last: its presence marks the snapshot complete
+    atomic_write(base + ".manifest.json",
+                 json.dumps(manifest, indent=1, sort_keys=True))
+    prune_snapshots(cfg.output_model, cfg.snapshot_keep)
+
+
+def prune_snapshots(output_model: str, keep: int) -> None:
+    """Delete all but the ``keep`` newest snapshots (model + sidecars);
+    ``keep <= 0`` keeps everything."""
+    if keep <= 0:
+        return
+    for _it, path in _list_snapshots(output_model)[keep:]:
+        for p in (path + ".manifest.json", path + ".state.npz", path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def find_latest_snapshot(output_model: str, signature: str,
+                         train_set) -> Optional[Tuple[int, str, np.ndarray]]:
+    """Newest VALID snapshot as ``(iteration, model_path, score)``, or
+    None.  Valid = manifest present and parseable, params signature and
+    data fingerprint match, state loads.  Invalid candidates are skipped
+    with a warning (an interrupted snapshot write leaves a model file
+    with no manifest — exactly the case this walks past)."""
+    fp = train_set.fingerprint()
+    for it, path in _list_snapshots(output_model):
+        man_path = path + ".manifest.json"
+        try:
+            with open(man_path) as f:
+                man = json.load(f)
+        except (OSError, ValueError) as e:
+            Log.warning(f"snapshot {path} skipped: manifest unreadable "
+                        f"({e})")
+            continue
+        if man.get("format") != _FORMAT:
+            Log.warning(f"snapshot {path} skipped: unknown manifest "
+                        f"format {man.get('format')!r}")
+            continue
+        if man.get("params_signature") != signature:
+            Log.warning(f"snapshot {path} skipped: training parameters "
+                        "differ from the run that wrote it")
+            continue
+        if man.get("data_fingerprint") != fp:
+            Log.warning(f"snapshot {path} skipped: dataset fingerprint "
+                        "differs from the run that wrote it")
+            continue
+        try:
+            with np.load(path + ".state.npz") as z:
+                score = np.asarray(z["score"], np.float32)
+        except (OSError, ValueError, KeyError) as e:
+            Log.warning(f"snapshot {path} skipped: state sidecar "
+                        f"unreadable ({e})")
+            continue
+        if int(man.get("iteration", -1)) != it:
+            Log.warning(f"snapshot {path} skipped: manifest iteration "
+                        f"{man.get('iteration')} != filename {it}")
+            continue
+        return it, path, score
+    return None

@@ -1,0 +1,172 @@
+"""Deterministic fault injection for the fault-tolerance test suite.
+
+Named injection sites are compiled into the hot paths as ONE dict-empty
+check (zero cost when inactive) and fire according to a spec from the
+``LGBM_TPU_FAULTS`` environment variable or :func:`configure`::
+
+    LGBM_TPU_FAULTS="device_claim:1-2,nan_grads:3"
+
+Spec grammar — comma-separated ``site:hits[:action]`` entries:
+
+- ``hits``: which occurrences of the site fire, counted from 1 —
+  ``3`` (exactly the 3rd hit), ``1-2`` (hits 1 and 2), ``4-`` (hit 4
+  onward).  For per-iteration sites (``nan_grads``) the hit index IS the
+  iteration number.
+- ``action`` (optional): ``raise`` (default — :class:`InjectedFault`, a
+  RuntimeError whose message matches the resilience layer's retryable
+  patterns), ``kill`` (:class:`InjectedKill`, a BaseException that
+  normal ``except Exception`` recovery cannot swallow — simulates the
+  process dying at the site), or ``exit`` (``os._exit(23)``, a REAL
+  death for subprocess tests).  Site ``snapshot_kill`` defaults to
+  ``kill``.
+
+Sites wired into the codebase:
+
+==================  ========================================================
+``device_claim``    device/backend bring-up (``GBDTModel._resolve_mesh``,
+                    ``parallel/launch.init``, ``parallel/mesh
+                    .init_distributed``) — exercises retry/backoff and
+                    ``dist_fallback_serial``
+``collective``      data-parallel grower dispatch
+                    (``parallel/data_parallel.make_dp_grower``)
+``snapshot_write``  entry of ``utils/resilience.atomic_write`` (every
+                    model/binary/manifest write)
+``snapshot_kill``   after the temp file is durable, before ``os.replace``
+                    — the kill-before-rename crash window
+``nan_grads``       gradient poisoning at iteration k
+                    (``models/gbdt.GBDTModel.train_one_iter``) —
+                    exercises ``finite_check_policy``
+==================  ========================================================
+
+Also exercisable from ``tools/tpu_watch.py`` probes: export
+``LGBM_TPU_FAULTS`` before starting the watcher and the probe child
+inherits it (its retry/backoff attempts are logged to the watch log).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+ENV_VAR = "LGBM_TPU_FAULTS"
+
+KNOWN_SITES = ("device_claim", "collective", "snapshot_write",
+               "snapshot_kill", "nan_grads")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a firing site.  The message deliberately matches the
+    resilience classifier's retryable patterns (UNAVAILABLE / claim) so
+    injected bring-up failures exercise the REAL retry path."""
+
+    def __init__(self, site: str, hit: int):
+        self.site = site
+        self.hit = hit
+        super().__init__(
+            f"injected fault at site '{site}' (hit {hit}): UNAVAILABLE: "
+            "simulated device claim/backend failure")
+
+
+class InjectedKill(BaseException):
+    """Simulated process death at a site.  Derives from BaseException so
+    ``except Exception`` recovery paths (snapshot skip-and-warn) cannot
+    swallow it — only the test harness catches it."""
+
+    def __init__(self, site: str, hit: int):
+        self.site = site
+        self.hit = hit
+        super().__init__(f"injected kill at site '{site}' (hit {hit})")
+
+
+# site -> (first_hit, last_hit_or_None_for_open_end, action)
+_spec: Dict[str, Tuple[int, Optional[int], str]] = {}
+_hits: Dict[str, int] = {}
+
+
+def configure(spec: Optional[str]) -> None:
+    """Install a fault spec (replacing any active one) and reset all hit
+    counters.  ``None``/empty disables injection entirely."""
+    _spec.clear()
+    _hits.clear()
+    if not spec:
+        return
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(f"bad fault spec entry {entry!r} "
+                             "(want site:hits[:action])")
+        site, hits = parts[0].strip(), parts[1].strip()
+        action = parts[2].strip() if len(parts) == 3 else (
+            "kill" if parts[0].strip() == "snapshot_kill" else "raise")
+        if site not in KNOWN_SITES:
+            raise ValueError(f"unknown fault site {site!r} "
+                             f"(known: {', '.join(KNOWN_SITES)})")
+        if action not in ("raise", "kill", "exit"):
+            raise ValueError(f"unknown fault action {action!r}")
+        if "-" in hits:
+            lo_s, hi_s = hits.split("-", 1)
+            lo = int(lo_s)
+            hi = int(hi_s) if hi_s else None
+        else:
+            lo = hi = int(hits)
+        if lo < 1 or (hi is not None and hi < lo):
+            raise ValueError(f"bad hit range in {entry!r}")
+        _spec[site] = (lo, hi, action)
+
+
+def clear() -> None:
+    """Disable injection and reset counters (test teardown)."""
+    configure(None)
+
+
+def enabled() -> bool:
+    """Whether ANY site is armed (used to gate zero-cost fast paths,
+    e.g. the fused-chunk program which cannot host per-iteration
+    injection)."""
+    return bool(_spec)
+
+
+def hits(site: str) -> int:
+    """How many times ``site`` was reached since configure()."""
+    return _hits.get(site, 0)
+
+
+def _advance(site: str) -> Tuple[bool, int, str]:
+    """Count a hit; return (fires, hit_index, action)."""
+    if site not in _spec:
+        return False, 0, "raise"
+    n = _hits.get(site, 0) + 1
+    _hits[site] = n
+    lo, hi, action = _spec[site]
+    return (n >= lo and (hi is None or n <= hi)), n, action
+
+
+def check(site: str) -> None:
+    """Raise/exit if ``site`` fires on this hit; no-op otherwise."""
+    if not _spec:
+        return
+    fire, n, action = _advance(site)
+    if not fire:
+        return
+    if action == "exit":
+        os._exit(23)
+    if action == "kill":
+        raise InjectedKill(site, n)
+    raise InjectedFault(site, n)
+
+
+def fires(site: str) -> bool:
+    """Non-raising variant for corruption sites (``nan_grads``): counts
+    the hit and reports whether it fires, leaving the action to the call
+    site (e.g. writing NaN into the gradient array)."""
+    if not _spec:
+        return False
+    fire, _n, _action = _advance(site)
+    return fire
+
+
+# arm from the environment at import (subprocess tests / tpu_watch probes)
+configure(os.environ.get(ENV_VAR))

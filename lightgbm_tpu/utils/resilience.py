@@ -1,0 +1,268 @@
+"""Fault-tolerance primitives: retry/backoff, watchdogs, atomic writes.
+
+Round-5 VERDICT.md recorded the failure mode this module exists for: the
+exclusive TPU tunnel wedged for ~10 hours and every probe died in ``claim
+hung`` or backend setup/compile errors — with no retry, no traceback from
+the hung call, and snapshots that were written non-atomically and never
+read back.  The reference hardens the same surface piecemeal (network
+retry in the socket learner, ``snapshot_freq`` in gbdt.cpp, continued
+training via ``init_model``); here it is one layer:
+
+- :class:`RetryPolicy` / :func:`retry_call` / :func:`retry` — jittered
+  exponential backoff with a hard deadline and an exception CLASSIFIER
+  (:func:`is_retryable_device_error`): transient device-claim /
+  backend-bring-up errors are retried, programming errors are not.
+- :class:`Watchdog` — arms ``faulthandler`` stack dumps while a blocking
+  device call (claim, compile, collective bring-up) is in flight, so a
+  wedge produces a traceback instead of silence.
+- :func:`atomic_write` — temp file in the target directory +
+  ``os.replace``, so a crash mid-write can never leave a truncated model
+  or binary cache behind.  Hosts the ``snapshot_write`` /
+  ``snapshot_kill`` fault-injection sites (utils/faultinject.py).
+
+Consumers: ``parallel/launch.py`` / ``parallel/mesh.py`` /
+``models/gbdt.py`` device bring-up, ``booster.py`` / ``dataset.py`` /
+``snapshot.py`` persistence, ``tools/tpu_watch.py`` claim probes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import faulthandler
+import functools
+import os
+import random
+import sys
+import tempfile
+import time
+from typing import Callable, Optional
+
+
+# ---------------------------------------------------------------------------
+# Exception classification
+# ---------------------------------------------------------------------------
+
+# Message fragments of transient device-claim / backend-init / network
+# failures (the axon relay's "claim hung", jax.distributed heartbeats,
+# gRPC status strings).  Matched case-insensitively against str(exc).
+_RETRYABLE_PATTERNS = (
+    "unavailable",
+    "deadline exceeded",
+    "deadline_exceeded",
+    "timed out",
+    "timeout",
+    "connection refused",
+    "connection reset",
+    "connection closed",
+    "failed to connect",
+    "socket closed",
+    "stream removed",
+    "resource exhausted",
+    "aborted",
+    "claim",
+    "heartbeat",
+    "coordination service",
+    "barrier",
+    "backend setup",
+    "initialization failed",
+)
+
+# Never retried regardless of message: programming / environment errors a
+# second attempt cannot fix, and control-flow exceptions.
+_FATAL_TYPES = (KeyboardInterrupt, SystemExit, GeneratorExit, MemoryError,
+                NotImplementedError, AssertionError, TypeError,
+                AttributeError, KeyError, IndexError, ImportError,
+                SyntaxError)
+
+
+def is_retryable_device_error(exc: BaseException) -> bool:
+    """Default classifier: True for transient device-claim / backend-init
+    shaped failures, False for programming errors.  ValueError is fatal
+    (bad arguments don't become good by waiting) EXCEPT LightGBMError
+    subclasses are still checked by message — they wrap device errors."""
+    if isinstance(exc, _FATAL_TYPES):
+        return False
+    if type(exc) is ValueError:
+        return False
+    msg = str(exc).lower()
+    return any(p in msg for p in _RETRYABLE_PATTERNS)
+
+
+# ---------------------------------------------------------------------------
+# Retry with jittered exponential backoff + hard deadline
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Backoff schedule for :func:`retry_call`.
+
+    max_attempts: total tries (1 = no retry).
+    base_delay_s: backoff before the 2nd attempt; doubles per attempt.
+    max_delay_s:  backoff cap.
+    deadline_s:   hard wall-clock budget across ALL attempts (0 = none);
+                  a retry that could not even START before the deadline
+                  re-raises instead of sleeping.
+    jitter:       fraction of each delay randomized (0..1): the slept
+                  delay is uniform in [d*(1-jitter/2), d*(1+jitter/2)],
+                  de-synchronizing a fleet of workers hammering one relay.
+    """
+    max_attempts: int = 3
+    base_delay_s: float = 1.0
+    max_delay_s: float = 30.0
+    deadline_s: float = 0.0
+    jitter: float = 0.5
+
+    @classmethod
+    def for_bringup(cls, retries: int, timeout_s: float) -> "RetryPolicy":
+        """The device/distributed bring-up schedule shared by
+        ``gbdt._resolve_mesh``, ``launch.init`` and
+        ``mesh.init_distributed``: ``retries`` re-attempts after the
+        first, a base delay scaled to 1% of the deadline (capped at
+        1 s), and the deadline itself as the hard budget."""
+        return cls(
+            max_attempts=max(1, int(retries) + 1),
+            base_delay_s=min(1.0, timeout_s / 100.0) if timeout_s > 0
+            else 1.0,
+            deadline_s=timeout_s)
+
+
+def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
+               classify: Optional[Callable[[BaseException], bool]] = None,
+               on_retry: Optional[Callable[[int, float, BaseException],
+                                           None]] = None,
+               label: str = "", **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying classified-transient
+    failures under ``policy``.  ``on_retry(attempt, delay_s, exc)`` is
+    invoked before each backoff sleep (tools/tpu_watch.py logs these).
+    The final failure is re-raised unmodified."""
+    policy = policy or RetryPolicy()
+    classify = classify or is_retryable_device_error
+    name = label or getattr(fn, "__name__", "call")
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            if attempt >= max(1, policy.max_attempts) or not classify(e):
+                raise
+            delay = min(policy.max_delay_s,
+                        policy.base_delay_s * (2.0 ** (attempt - 1)))
+            if policy.jitter > 0:
+                delay *= 1.0 + policy.jitter * (random.random() - 0.5)
+            if policy.deadline_s > 0 and \
+                    time.monotonic() - t0 + delay > policy.deadline_s:
+                from .log import Log
+                Log.warning(
+                    f"{name}: retry deadline ({policy.deadline_s:g}s) "
+                    f"exhausted after attempt {attempt}; giving up")
+                raise
+            from .log import Log
+            Log.warning(
+                f"{name}: attempt {attempt}/{policy.max_attempts} failed "
+                f"({e}); retrying in {delay:.1f}s")
+            if on_retry is not None:
+                on_retry(attempt, delay, e)
+            time.sleep(delay)
+
+
+def retry(policy: Optional[RetryPolicy] = None, **retry_kwargs):
+    """Decorator form of :func:`retry_call`::
+
+        @retry(RetryPolicy(max_attempts=4))
+        def claim(): ...
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy, **retry_kwargs,
+                              **kwargs)
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Watchdog: faulthandler stack dumps for wedged blocking calls
+# ---------------------------------------------------------------------------
+
+class Watchdog:
+    """Context manager arming periodic ``faulthandler`` stack dumps while
+    a blocking device call is in flight::
+
+        with Watchdog(cfg.dist_init_timeout_s, label="device claim"):
+            devs = jax.devices()
+
+    If the call exceeds ``timeout_s`` the interpreter dumps every
+    thread's stack to stderr (repeating each ``timeout_s``) — the
+    round-5 wedge produced NO traceback for 10 hours; this makes the
+    hang loud and attributable.  ``timeout_s <= 0`` disables.
+
+    ``faulthandler``'s later-dump timer is process-global: nesting
+    Watchdogs (or combining with pytest's per-test dump) leaves the
+    innermost exit having cancelled the outer timer.  Acceptable for the
+    bring-up call sites this guards — they do not nest.
+    """
+
+    def __init__(self, timeout_s: float, label: str = "",
+                 file=None) -> None:
+        self.timeout_s = float(timeout_s)
+        self.label = label
+        self.file = file
+
+    def __enter__(self) -> "Watchdog":
+        if self.timeout_s > 0:
+            faulthandler.dump_traceback_later(
+                self.timeout_s, repeat=True,
+                file=self.file if self.file is not None else sys.stderr)
+            from .log import Log
+            Log.debug(f"watchdog armed ({self.timeout_s:g}s) around "
+                      f"{self.label or 'blocking call'}")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.timeout_s > 0:
+            faulthandler.cancel_dump_traceback_later()
+
+
+# ---------------------------------------------------------------------------
+# Atomic file writes (temp + os.replace)
+# ---------------------------------------------------------------------------
+
+def atomic_write(path, data, binary: bool = False) -> None:
+    """Write ``data`` to ``path`` atomically: temp file in the TARGET
+    directory (``os.replace`` requires same-filesystem), fsync, rename.
+    A crash at any point leaves either the old file or the new file —
+    never a truncated hybrid.  Creates missing parent directories (a
+    relative ``output_model`` in a fresh working dir used to make every
+    snapshot write raise).
+
+    Fault-injection sites (utils/faultinject.py): ``snapshot_write``
+    fires before anything is written; ``snapshot_kill`` fires after the
+    temp file is durable but BEFORE the rename — the kill-before-rename
+    crash window.  An injected kill deliberately leaves the temp file
+    behind, like a real crash would."""
+    from . import faultinject
+    faultinject.check("snapshot_write")
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb" if binary else "w") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # kill-before-rename window: InjectedKill is a BaseException and the
+    # cleanup above only catches Exception, so the temp file survives —
+    # exactly the debris a real crash leaves (readers must ignore *.tmp)
+    faultinject.check("snapshot_kill")
+    os.replace(tmp, path)

@@ -31,8 +31,28 @@ from lightgbm_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E4
 
 enable_persistent_cache()
 
+import faulthandler  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Per-test hang watchdog: a wedged collective / device claim used to eat
+# the whole tier-1 870 s budget silently (the outer `timeout -k 10 870`
+# kills pytest with NO traceback).  Arm a faulthandler dump per test: any
+# test still running after this many seconds dumps all-thread stacks to
+# stderr (repeating, non-fatal) so the hang is attributable to a line of
+# code.  Same mechanism as lightgbm_tpu.utils.resilience.Watchdog — the
+# timer is process-global, so a Watchdog used INSIDE a test takes over
+# until it exits (its cancel also clears this per-test timer; acceptable).
+FAULTHANDLER_TEST_TIMEOUT_S = 300.0
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    faulthandler.dump_traceback_later(FAULTHANDLER_TEST_TIMEOUT_S,
+                                      repeat=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
 
 # Skip budget (VERDICT r2: a regressing guard skipped instead of failing
 # and nobody noticed).  On the standard harness — virtual 8-device CPU

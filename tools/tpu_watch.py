@@ -52,13 +52,31 @@ def spawn_probe() -> subprocess.Popen:
     """One orphaned claim probe; never killed (see module docstring) —
     but a probe that EXITS on its own (e.g. 'TPU backend setup/compile
     error (Unavailable)' when the relay is mid-wedge or mid-handover)
-    holds nothing, so the caller may safely spawn a replacement."""
-    code = ("import time,sys\n"
-            "t0=time.time()\n"
-            "import jax\n"
-            "d=jax.devices()\n"
-            "print('PROBE_OK', d[0].device_kind, round(time.time()-t0,2),"
-            " flush=True)\n")
+    holds nothing, so the caller may safely spawn a replacement.
+
+    The claim runs under the in-repo resilience layer
+    (lightgbm_tpu/utils/resilience.py): transient backend-init failures
+    back off and retry INSIDE the probe, and every attempt is printed as
+    a ``PROBE_RETRY`` line that the watcher relays into the watch log —
+    the round-5 wedge left no trace of what the claim was doing.  Fault
+    sites stay armable: an LGBM_TPU_FAULTS env spec is inherited by the
+    probe child (utils/faultinject.py reads it at import)."""
+    code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from lightgbm_tpu.utils.resilience import RetryPolicy, retry_call\n"
+        "t0 = time.time()\n"
+        "def _claim():\n"
+        "    import jax\n"
+        "    return jax.devices()\n"
+        "def _note(attempt, delay, err):\n"
+        "    print(f'PROBE_RETRY attempt={attempt} backoff={delay:.0f}s'\n"
+        "          f' err={err}', flush=True)\n"
+        "d = retry_call(_claim, policy=RetryPolicy(max_attempts=4,\n"
+        "    base_delay_s=30, max_delay_s=600), label='tpu-claim',\n"
+        "    on_retry=_note)\n"
+        "print('PROBE_OK', d[0].device_kind, round(time.time()-t0,2),"
+        " flush=True)\n")
     with open(PROBE_OUT, "w") as out:
         return subprocess.Popen([sys.executable, "-c", code], stdout=out,
                                 stderr=subprocess.STDOUT,
@@ -117,6 +135,7 @@ def main() -> None:
     probe = spawn_probe()
     t_probe = time.time()
     retry_backoff = 60
+    relayed_retries = set()
     while time.time() < deadline:
         time.sleep(POLL_S)
         try:
@@ -124,6 +143,12 @@ def main() -> None:
                 out = f.read()
         except OSError:
             out = ""
+        # relay the probe's resilience-layer retry/backoff attempts into
+        # the durable watch log (each attempt once)
+        for ln in out.splitlines():
+            if ln.startswith("PROBE_RETRY") and ln not in relayed_retries:
+                relayed_retries.add(ln)
+                log(f"probe backoff: {ln}")
         if "PROBE_OK" in out:
             log(f"claim landed after {time.time() - t_probe:.0f}s: "
                 f"{out.strip().splitlines()[-1]}")
