@@ -361,6 +361,27 @@ def child_extras() -> None:
         _record_point("dp_owner_shard_hist_bytes_per_leaf",
                       error=f"{type(e).__name__}: {e}"[:200])
 
+    # comm wire bytes per boosting iteration (obs/comm.py static model,
+    # same math the telemetry counters use at train time): the in-flight
+    # number arXiv:1706.08359 instruments to validate scaling — one
+    # reduce-scattered hist pass per split, (leaves-1) splits/tree
+    try:
+        from lightgbm_tpu.obs.comm import dp_hist_bytes_per_iter
+        from lightgbm_tpu.parallel.mesh import owner_shard_plan
+        pts = {}
+        for wname, f in (("higgs28", 28), ("bosch968", 968),
+                         ("allstate4228", 4228)):
+            for s in (8, 16):
+                plan = owner_shard_plan(np.arange(f), s)
+                pts[f"{wname}_x{s}"] = dp_hist_bytes_per_iter(
+                    s, plan.chunk, PRIMARY_PADDED_BIN,
+                    n_steps=PRIMARY_LEAVES - 1)
+        _record_point("comm_bytes_per_iter", cpu=cpu,
+                      leaves=PRIMARY_LEAVES, **pts)
+    except Exception as e:
+        _record_point("comm_bytes_per_iter",
+                      error=f"{type(e).__name__}: {e}"[:200])
+
     if cpu:
         return                       # 10M-row point is TPU-only
     # 10M-row scaling point (VERDICT r2 task 3b)
@@ -570,6 +591,13 @@ def main():
                 extra["higgs1m_31leaf_sb8_auc"] = p["auc"]
                 if p.get("steps_per_tree") is not None:
                     extra["higgs1m_31leaf_sb8_steps"] = p["steps_per_tree"]
+            continue
+        if "value" not in p and "error" not in p:
+            # keyed payload points (hist-bytes shapes, comm_bytes_per_iter
+            # from the obs/comm static model): fold every data key
+            for k_src, v in p.items():
+                if k_src not in ("point", "t", "cpu"):
+                    extra[f"{name}_{k_src}"] = v
             continue
         if "value" in p:
             extra[name + "_iters_per_sec"] = p["value"]

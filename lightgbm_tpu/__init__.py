@@ -14,7 +14,7 @@ from .basic import LightGBMError
 from .binning import BinMapper, BinType, MissingType
 from .booster import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter)
+                       log_telemetry, record_evaluation, reset_parameter)
 from .config import Config
 from .dataset import Dataset, Sequence
 from .engine import CVBooster, cv, train
@@ -26,8 +26,8 @@ from .utils.log import register_logger
 __all__ = [
     "BinMapper", "BinType", "MissingType", "Booster", "Config", "CVBooster",
     "Dataset", "EarlyStopException", "LightGBMError", "Sequence", "cv",
-    "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "train",
+    "early_stopping", "log_evaluation", "log_telemetry",
+    "record_evaluation", "reset_parameter", "train",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "DaskLGBMRegressor", "DaskLGBMClassifier", "DaskLGBMRanker",
     "register_logger",

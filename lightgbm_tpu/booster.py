@@ -199,6 +199,25 @@ class Booster:
         self._sync_trees()
         return self
 
+    # -- telemetry (obs/ subsystem; docs/Observability.md) ----------------
+    def telemetry_snapshot(self) -> dict:
+        """Current metrics snapshot (deterministic dict; {} when
+        ``telemetry=false`` or this booster was loaded from a model
+        file).  Multi-process: per-shard registries are gathered and
+        merged, so every process sees host 0's aggregated view."""
+        m = self._model
+        if m is None or getattr(m, "_obs", None) is None:
+            return {}
+        return m._obs.snapshot()
+
+    def telemetry_finish(self) -> dict:
+        """Stop any active profiler window, flush the JSONL trace sink,
+        and return the final aggregated metrics snapshot."""
+        m = self._model
+        if m is None or getattr(m, "_obs", None) is None:
+            return {}
+        return m._obs.finish()
+
     def _sync_trees(self) -> None:
         self.trees = self._model.models
         self.tree_weights = self._model.tree_weights

@@ -69,6 +69,54 @@ def record_evaluation(eval_result: Dict) -> Callable:
     return _callback
 
 
+def log_telemetry(period: int = 10, collect: Dict = None) -> Callable:
+    """Log (and optionally collect) obs metrics snapshots during
+    training (docs/Observability.md).  Every ``period`` iterations the
+    booster's aggregated snapshot is summarized via ``Log.info`` —
+    iteration count, mean per-phase milliseconds, cumulative comm wire
+    bytes — and, when ``collect`` is given, stored whole under the
+    1-based iteration number.  A no-op unless ``telemetry=true``."""
+
+    def _summary(snap: Dict) -> str:
+        parts = []
+        it = snap.get("train.iterations")
+        if it:
+            parts.append(f"iters={it['value']:g}")
+        for key, rec in snap.items():
+            if key.startswith("train.phase_seconds{") \
+                    and rec.get("count"):
+                phase = key.split("phase=", 1)[1].rstrip("}")
+                parts.append(
+                    f"{phase}={rec['sum'] / rec['count'] * 1e3:.1f}ms")
+        wire = sum(rec["value"] for key, rec in snap.items()
+                   if key.startswith("comm.wire_bytes{"))
+        if wire:
+            parts.append(f"comm={wire / 1e6:.2f}MB")
+        return " ".join(parts) or "(no telemetry data)"
+
+    def _callback(env: CallbackEnv) -> None:
+        if period <= 0 or (env.iteration + 1) % period != 0:
+            return
+        boosters = getattr(env.model, "boosters", None) or [env.model]
+        many = len(boosters) > 1          # cv: one snapshot per fold
+        for bi, bst in enumerate(boosters):
+            snap_fn = getattr(bst, "telemetry_snapshot", None)
+            snap = snap_fn() if snap_fn is not None else {}
+            if not snap:
+                continue
+            if collect is not None:
+                if many:
+                    collect.setdefault(env.iteration + 1, []).append(snap)
+                else:
+                    collect[env.iteration + 1] = snap
+            from .utils.log import Log
+            tag = f" fold {bi}" if many else ""
+            Log.info(f"[telemetry] [{env.iteration + 1}]{tag} "
+                     f"{_summary(snap)}")
+    _callback.order = 40
+    return _callback
+
+
 def reset_parameter(**kwargs) -> Callable:
     """Per-iteration parameter schedule; supports ``learning_rate`` as a
     list or ``f(iteration) -> value`` (callback.py reset_parameter)."""

@@ -202,6 +202,18 @@ _PARAMS: Dict[str, tuple] = {
     # different (still best-first) growth order.  0 = auto: 1 below 64
     # leaves, then 8.
     "split_batch": (int, 0, []),
+    # ---- telemetry / observability ----
+    # master switch for the obs subsystem (lightgbm_tpu/obs/): per-phase
+    # spans + metrics registry + comm-bytes counters on the training
+    # loop.  false (default) keeps the hot path byte-identical: zero
+    # extra host syncs, no per-iteration allocation beyond a branch
+    "telemetry": (bool, False, []),
+    # JSONL span sink path; convert with obs.trace.jsonl_to_chrome for
+    # Perfetto / chrome://tracing.  Empty = in-memory events only
+    "telemetry_trace_file": (str, "", []),
+    # [k, n] — capture iterations [k, k+n) with jax.profiler (best
+    # effort; requires telemetry=true).  [k] captures one iteration
+    "telemetry_profile_iters": (list, None, []),
     # ---- fault tolerance ----
     # retries after the first failed device-claim / jax.distributed
     # bring-up attempt (jittered exponential backoff, utils/resilience.py)
@@ -385,6 +397,16 @@ class Config:
             merged.update(params)
         merged.update(kw)
         self.raw_params: Dict[str, Any] = dict(merged)
+        # apply the requested (or default) verbosity BEFORE parsing, so
+        # parse-time warnings (unknown parameters) honor THIS
+        # construction's level rather than a previous Config's — the
+        # level is process-global, like the reference's Log state
+        from .utils.log import Log
+        v = merged.get("verbosity", merged.get("verbose", self.verbosity))
+        try:
+            Log.set_verbosity(_coerce("verbosity", int, v))
+        except (TypeError, ValueError):
+            pass            # bad value: surfaced by _set's typed coerce
         self._set(merged)
         self._check_param_conflict()
 
@@ -458,6 +480,15 @@ class Config:
             raise ValueError(
                 f"finite_check_policy={self.finite_check_policy!r} must be "
                 "one of: raise, skip_iter, clamp")
+        if self.telemetry_profile_iters is not None \
+                and len(self.telemetry_profile_iters) not in (1, 2):
+            raise ValueError(
+                "telemetry_profile_iters must be [start] or [start, count]")
+        # verbosity drives the global log level with reference semantics
+        # (config.h: <0 fatal-only, 0 warnings, 1 info, >=2 debug; the
+        # reference's Config::Set calls Log::ResetLogLevel the same way)
+        from .utils.log import Log
+        Log.set_verbosity(self.verbosity)
         if self.eval_at is None:
             self.eval_at = [1, 2, 3, 4, 5]
 

@@ -515,6 +515,16 @@ class GBDTModel:
         self._goss = config.data_sample_strategy == "goss"
         self._last_iter_state: Optional[dict] = None
 
+        # telemetry (obs/): None when telemetry=false — the hot paths
+        # below only ever test this for None, so the default adds zero
+        # host syncs and no per-iteration allocation beyond the branch
+        from ..obs import maybe_session
+        self._obs = maybe_session(config)
+        if self._obs is not None:
+            ledger = getattr(self.grower, "comm", None)
+            if ledger is not None:
+                self._obs.attach_comm_sites(ledger)
+
     def _fit_linear_leaves(self, arrays: TreeArrays, ht: Tree, g, h, w,
                            shrinkage: float, bias: float) -> None:
         """Per-leaf linear models (LinearTreeLearner::CalculateLinear,
@@ -1167,6 +1177,16 @@ class GBDTModel:
             if init0 != 0.0:
                 self.score = self.score + jnp.float32(init0)
 
+        obs = self._obs
+        if obs is not None:
+            _sp = obs.tracer.span("train_chunk", n_iters=k,
+                                  iteration=start_iter)
+            if obs.profiler is not None:
+                # the chunk is ONE device program: the capture window
+                # opens if any requested iteration falls inside it
+                for it in range(start_iter, start_iter + k):
+                    obs.profiler.on_iter_begin(it)
+
         chunk = self._fused_chunk_fn()
         if cfg.feature_fraction < 1.0:
             fmasks = jnp.asarray(
@@ -1182,6 +1202,10 @@ class GBDTModel:
                                                cuse0)
         # the one sync per chunk (tree records + finite-guard flags)
         host, bad_host = jax.device_get((stacked, bad_flags))
+        if obs is not None:
+            _sp.end()                  # device_get above already blocked
+            if obs.profiler is not None:
+                obs.profiler.on_iter_end(start_iter + k - 1)
 
         lr = self.learning_rate
         stopped = False
@@ -1242,6 +1266,12 @@ class GBDTModel:
             self.iter_ += 1
             if stopped:
                 break
+        if obs is not None:
+            done = self.iter_ - start_iter
+            obs.metrics.counter("train.iterations").inc(done)
+            obs.metrics.counter("train.fused_chunks").inc()
+            for s in self.step_counts[len(self.step_counts) - done:]:
+                obs.metrics.histogram("train.steps_per_tree").observe(s)
         self._last_iter_state = None    # rollback not supported past a chunk
         return stopped
 
@@ -1250,6 +1280,8 @@ class GBDTModel:
         """One boosting iteration (gbdt.cpp:371 TrainOneIter).
         Returns True if training should stop (no splits possible)."""
         cfg = self.config
+        obs = self._obs
+        t_iter0 = obs.iter_begin(self.iter_) if obs is not None else 0.0
         init_scores = [0.0] * self.num_class
         if self.iter_ == 0 and self.objective is not None \
                 and cfg.boost_from_average and not self._init_applied:
@@ -1268,6 +1300,8 @@ class GBDTModel:
         gscore = self._score_for_gradients()
         if self._bias_in_every_tree:
             init_scores = list(getattr(self, "_init_scores", init_scores))
+        if obs is not None:
+            _sp = obs.phase("grad", self.iter_)
         if grad is None:
             g_all, h_all = self.objective.get_gradients(
                 gscore[:, 0] if self.num_class == 1 else gscore)
@@ -1280,6 +1314,8 @@ class GBDTModel:
         else:
             g_all = g_all.reshape(self.num_data, self.num_class)
             h_all = h_all.reshape(self.num_data, self.num_class)
+        if obs is not None:
+            obs.phase_metric("grad", _sp.end((g_all, h_all)))
 
         it_global = self.iter_ + self._iter_rng_offset
         # fault injection: gradient poisoning at iteration k (the
@@ -1348,6 +1384,8 @@ class GBDTModel:
                     gkw["cegb_used"] = jnp.asarray(self._cegb_state.used)
             vals_g = self._prep_vals(vals)
             fmask_g = self._prep_fmask(fmask)
+            if obs is not None:
+                _sp = obs.phase("grow", self.iter_)
             if self._dist == "feature":
                 arrays = self.grower(self.binned_dev, vals_g, fmask_g,
                                      self._nb_grow, self._na_grow,
@@ -1355,6 +1393,9 @@ class GBDTModel:
             else:
                 arrays = self.grower(self.binned_dev, vals_g, fmask_g,
                                      self._nb_grow, self._na_grow, **gkw)
+            if obs is not None:
+                obs.phase_metric("grow", _sp.end(arrays.num_leaves))
+                _sp = obs.phase("fetch", self.iter_)
             if self._pc > 1 and self._dist is not None:
                 # multi-process: the grower returned GLOBAL arrays (tree
                 # fields replicated, leaf_of_row row-sharded).  Mixing
@@ -1377,6 +1418,9 @@ class GBDTModel:
             # paths need it) — matters when the chip is behind a tunnel
             small = arrays._replace(leaf_of_row=arrays.num_leaves)
             host = jax.device_get(small)._replace(leaf_of_row=arrays.leaf_of_row)
+            if obs is not None:
+                # device_get blocks by itself; no fence needed
+                obs.phase_metric("fetch", _sp.end())
             nl = int(host.num_leaves)
             # perf observability: grower loop steps per tree (== splits
             # for strict leaf-wise; the super-step count for split_batch)
@@ -1460,6 +1504,8 @@ class GBDTModel:
             ht.shrinkage = shrinkage
             iter_trees.append(ht)
 
+            if obs is not None:
+                _sp = obs.phase("score", self.iter_)
             linear = cfg.linear_tree and nl > 1
             if linear:
                 # fit per-leaf linear models on bias-free leaf values, then
@@ -1480,6 +1526,8 @@ class GBDTModel:
                 lv_dev = jnp.asarray(dev_values, jnp.float32)
                 delta = jnp.take(lv_dev, arrays.leaf_of_row)
                 self.score = self.score.at[:, k].add(delta)
+            if obs is not None:
+                obs.phase_metric("score", _sp.end(self.score))
             iter_state["train_deltas"].append(delta)
 
             steps = round_up_pow2(max(ht.max_depth(), 1))
@@ -1521,6 +1569,11 @@ class GBDTModel:
         self.models.extend(iter_trees)
         self._last_iter_state = iter_state
         self.iter_ += 1
+        if obs is not None:
+            # all of this iteration's trees (num_class of them) count
+            # toward its step/comm accounting
+            obs.iter_end(self.iter_ - 1, t_iter0,
+                         sum(self.step_counts[-self.num_class:]))
         return stopped
 
     def rollback_one_iter(self) -> None:

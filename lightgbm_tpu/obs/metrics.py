@@ -1,0 +1,205 @@
+"""Metrics registry: labeled counters/gauges/histograms, dict export,
+shard-aware aggregation.
+
+Prometheus-shaped but in-process: instruments are created lazily by
+(name, sorted label items) and are plain Python objects — incrementing
+a counter is one dict lookup + float add, cheap enough for per-iteration
+use, and nothing here ever touches the device (device-derived values
+must be fetched by the caller, ideally once per snapshot).
+
+``snapshot()`` is deterministic: keys are the canonical
+``name{k=v,...}`` strings with labels sorted, values plain
+JSON-serializable dicts — so two processes that did the same work
+produce byte-identical snapshots (the dp==serial test relies on this).
+
+Multi-process: ``gather_snapshots`` allgathers every process's
+snapshot (JSON-encoded through the same fixed-shape u8 transport
+``multihost_utils`` needs) and ``aggregate_snapshots`` merges them —
+counters sum, gauges keep per-shard values under a ``shard`` label,
+histograms merge bucket-wise.  Single-process, both are identity-like.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+# default histogram buckets: log-ish spacing covering µs..minutes for
+# time-valued series and 1..1e9 for count-valued ones
+_DEFAULT_BUCKETS = (1e-5, 1e-4, 1e-3, 1e-2, 0.1, 0.25, 1.0, 2.5, 10.0,
+                    60.0, 600.0)
+
+
+def _key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def export(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def export(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def export(self) -> Dict[str, Any]:
+        return {"type": "histogram", "count": self.count, "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "buckets": list(self.buckets), "counts": list(self.counts)}
+
+
+class MetricsRegistry:
+    """Lazy instrument registry; thread-safe creation, lock-free use."""
+
+    def __init__(self):
+        self._instruments: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, labels: Dict[str, Any], **kw):
+        key = _key(name, labels)
+        inst = self._instruments.get(key)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.setdefault(key, cls(**kw))
+        if not isinstance(inst, cls):
+            raise TypeError(f"metric {key!r} already registered as "
+                            f"{type(inst).__name__}, not {cls.__name__}")
+        return inst
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Tuple[float, ...]] = None,
+                  **labels: Any) -> Histogram:
+        kw = {"buckets": tuple(buckets)} if buckets else {}
+        return self._get(Histogram, name, labels, **kw)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Deterministic plain-dict export (sorted keys)."""
+        with self._lock:
+            items = sorted(self._instruments.items())
+        return {k: inst.export() for k, inst in items}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+# -- shard-aware aggregation ----------------------------------------------
+
+def aggregate_snapshots(snaps: List[Dict[str, Dict[str, Any]]]
+                        ) -> Dict[str, Dict[str, Any]]:
+    """Merge per-shard snapshots into one: counters sum, histograms
+    merge bucket-wise (bucket layouts must match — they come from the
+    same code), gauges that DIFFER across shards are kept per-shard
+    under an added ``shard`` label while agreeing gauges collapse.
+    Deterministic: output keys sorted, merge order is the list order."""
+    if len(snaps) == 1:
+        return dict(sorted(snaps[0].items()))
+    out: Dict[str, Dict[str, Any]] = {}
+    gauge_seen: Dict[str, List[Tuple[int, float]]] = {}
+    for si, snap in enumerate(snaps):
+        for key, rec in snap.items():
+            t = rec.get("type")
+            if t == "gauge":
+                gauge_seen.setdefault(key, []).append(
+                    (si, rec.get("value", 0.0)))
+                continue
+            cur = out.get(key)
+            if cur is None:
+                out[key] = json.loads(json.dumps(rec))   # deep copy
+            elif t == "counter":
+                cur["value"] += rec["value"]
+            elif t == "histogram":
+                cur["count"] += rec["count"]
+                cur["sum"] += rec["sum"]
+                for mi, (a, b) in enumerate(zip(cur["counts"],
+                                                rec["counts"])):
+                    cur["counts"][mi] = a + b
+                for f, pick in (("min", min), ("max", max)):
+                    vals = [v for v in (cur[f], rec[f]) if v is not None]
+                    cur[f] = pick(vals) if vals else None
+    for key, vals in gauge_seen.items():
+        if len({v for _, v in vals}) == 1:
+            out[key] = {"type": "gauge", "value": vals[0][1]}
+        else:
+            base, brace, rest = key.partition("{")
+            for si, v in vals:
+                inner = f"shard={si}" + ("," + rest[:-1] if brace else "")
+                out[f"{base}{{{inner}}}"] = {"type": "gauge", "value": v}
+    return dict(sorted(out.items()))
+
+
+def gather_snapshots(snap: Dict[str, Dict[str, Any]]
+                     ) -> List[Dict[str, Dict[str, Any]]]:
+    """All processes' snapshots, in process order (multi-process pods;
+    identity wrapper for a single process).  JSON rides a fixed-shape
+    u8 array: ``process_allgather`` needs congruent shapes, so every
+    process pads its encoding to the allreduced max length."""
+    import jax
+    if jax.process_count() <= 1:
+        return [snap]
+    import numpy as np
+    from jax.experimental import multihost_utils
+    raw = json.dumps(snap).encode()
+    n = np.asarray(len(raw))
+    nmax = int(np.max(multihost_utils.process_allgather(n)))
+    buf = np.zeros(nmax + 8, np.uint8)
+    buf[:8] = np.frombuffer(np.asarray([len(raw)], np.int64).tobytes(),
+                            np.uint8)
+    buf[8:8 + len(raw)] = np.frombuffer(raw, np.uint8)
+    allbuf = np.asarray(multihost_utils.process_allgather(buf))
+    out = []
+    for row in allbuf:
+        ln = int(np.frombuffer(row[:8].tobytes(), np.int64)[0])
+        out.append(json.loads(row[8:8 + ln].tobytes().decode()))
+    return out

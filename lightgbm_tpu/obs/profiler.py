@@ -1,0 +1,62 @@
+"""Opt-in ``jax.profiler`` capture of a training-iteration window.
+
+``telemetry_profile_iters=[k, n]`` captures iterations [k, k+n) into a
+TensorBoard-loadable trace directory.  The window is driven by the GBDT
+iteration loop (models/gbdt.py) through ``on_iter_begin``/``on_iter_end``
+so the capture brackets exactly the requested iterations — including
+their compile, if iteration k is the first of a new jitted shape.
+
+The capture is best-effort by design: profiler availability differs per
+backend (the axon tunnel has no profiler service), and a failed start
+must never kill a training run — failures are logged once and the
+window deactivates itself.
+"""
+
+from __future__ import annotations
+
+import atexit
+
+
+class ProfilerWindow:
+    """Capture iterations [start, start + count) with jax.profiler."""
+
+    def __init__(self, start: int, count: int, logdir: str):
+        self.start = int(start)
+        self.count = max(int(count), 1)
+        self.logdir = logdir
+        self.active = False
+        self._dead = False        # start failed: stay off for the run
+
+    def on_iter_begin(self, it: int) -> None:
+        if self._dead or self.active or it != self.start:
+            return
+        try:
+            import jax.profiler
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+            # a crash inside the window must still flush the capture
+            atexit.register(self.finish)
+            from ..utils.log import Log
+            Log.info(f"telemetry: jax.profiler capturing iterations "
+                     f"[{self.start}, {self.start + self.count}) -> "
+                     f"{self.logdir}")
+        except Exception as e:   # no profiler on this backend
+            self._dead = True
+            from ..utils.log import Log
+            Log.warning(f"telemetry: jax.profiler capture unavailable "
+                        f"({e}); continuing without it")
+
+    def on_iter_end(self, it: int) -> None:
+        if self.active and it + 1 >= self.start + self.count:
+            self.finish()
+
+    def finish(self) -> None:
+        if not self.active:
+            return
+        self.active = False
+        try:
+            import jax.profiler
+            jax.profiler.stop_trace()
+        except Exception as e:
+            from ..utils.log import Log
+            Log.warning(f"telemetry: jax.profiler stop failed ({e})")

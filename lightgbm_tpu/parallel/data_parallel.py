@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..grower import TreeArrays, make_grower
+from ..obs.comm import CommLedger
 from ..ops.histogram import pad_feature_axis
 from ..ops.split import (SplitParams, SplitResult, gather_best,
                          globalize_feature)
@@ -84,18 +85,23 @@ def _dp_out_specs(axis: str) -> TreeArrays:
         leaf_of_row=P(axis), is_cat_node=P(), cat_rank=P(), n_steps=P())
 
 
-def owner_hist_reduce(axis: str, n_shards: int, chunk: int):
+def owner_hist_reduce(axis: str, n_shards: int, chunk: int,
+                      ledger: CommLedger = None):
     """The ReduceScatter hook: pad the histogram's feature-group axis to
     ``n_shards * chunk`` rows and ``psum_scatter`` it, leaving each shard
     with its owned ``[chunk, B, C]`` slice of the GLOBAL histograms
     (data_parallel_tree_learner.cpp:185's communication shape; XLA
     lowers this to a true reduce-scatter over ICI, moving 1/n_shards of
-    the bytes a full psum replicates to every chip)."""
+    the bytes a full psum replicates to every chip).  ``ledger`` records
+    the payload statically at trace time (obs/comm.py)."""
     total = n_shards * chunk
 
     def hist_reduce(h):
-        return lax.psum_scatter(pad_feature_axis(h, total), axis,
-                                scatter_dimension=0, tiled=True)
+        h = pad_feature_axis(h, total)
+        if ledger is not None:
+            return ledger.psum_scatter(h, axis, site="dp.hist_reduce",
+                                       scatter_dimension=0, tiled=True)
+        return lax.psum_scatter(h, axis, scatter_dimension=0, tiled=True)
 
     return hist_reduce
 
@@ -156,6 +162,7 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
     n_shards = mesh.shape[axis]
     out_specs = _dp_out_specs(axis)
     cache = {}
+    ledger = CommLedger(n_shards)     # static comm-bytes sites (obs/comm)
 
     def _build(nf: int, sparse_key=None):
         group_of = np.asarray(efb.group_host) if efb is not None \
@@ -163,7 +170,7 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
         plan = owner_shard_plan(group_of, n_shards)
         sf_dev = jnp.asarray(plan.shard_feat)        # [S, fmax] global ids
         chunk, fmax = plan.chunk, plan.fmax
-        hist_reduce = owner_hist_reduce(axis, n_shards, chunk)
+        hist_reduce = owner_hist_reduce(axis, n_shards, chunk, ledger)
 
         def _gfid():
             """This shard's scan-slot -> global-feature map (in-graph)."""
@@ -206,13 +213,15 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                              jnp.take(m, jnp.maximum(gfid, 0)), 0)
 
         def select_best(res: SplitResult) -> SplitResult:
+            ledger.note_all_gather(res, site="dp.best_split")
             return gather_best(globalize_feature(res, _gfid()), axis)
 
         inner = make_grower(
             num_leaves=num_leaves, num_bins=num_bins, params=params,
             max_depth=max_depth, block_rows=block_rows,
             hist_reduce=hist_reduce,
-            sum_reduce=lambda t: lax.psum(t, axis),
+            sum_reduce=lambda t: ledger.psum(t, axis, site="dp.root_sum",
+                                             cadence="tree"),
             hist_expand=hist_expand, select_best=select_best,
             efb=efb, split_batch=split_batch, mono=mono,
             mono_view=None if mono is None else mono_view,
@@ -275,6 +284,7 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                   is_cat)
 
     grow.owner_shard = True
+    grow.comm = ledger
     if efb is not None:
         # bundle structure is static: expose the plan before the first call
         grow.plan = owner_shard_plan(np.asarray(efb.group_host), n_shards)
@@ -288,11 +298,14 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
     global histograms and recomputes the split decision replicated (no
     separate best-split sync needed — but per-chip histogram state scales
     with the full feature width; see the owner-shard default)."""
+    ledger = CommLedger(mesh.shape[axis])
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
-        hist_reduce=lambda h: lax.psum(h, axis),
-        sum_reduce=lambda t: lax.psum(t, axis), efb=efb,
+        hist_reduce=lambda h: ledger.psum(h, axis, site="dp.hist_psum"),
+        sum_reduce=lambda t: ledger.psum(t, axis, site="dp.root_sum",
+                                         cadence="tree"),
+        efb=efb,
         split_batch=split_batch, mono=mono, mono_penalty=mono_penalty,
         jit=False)
 
@@ -329,6 +342,7 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                               is_cat)
 
         grow.owner_shard = False
+        grow.comm = ledger
         return grow
 
     f = shard_map(
@@ -345,4 +359,5 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
         return jitted(binned, vals, feature_mask, num_bin, na_bin, is_cat)
 
     grow.owner_shard = False
+    grow.comm = ledger
     return grow

@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..grower import TreeArrays, make_grower
+from ..obs.comm import CommLedger
 from ..ops.split import SplitParams, SplitResult, gather_best
 from ..utils.jax_compat import shard_map
 
@@ -42,6 +43,7 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
         raise ValueError(f"num_features {num_features} must divide over "
                          f"{n_shards} shards (pad with masked features)")
     f_local = num_features // n_shards
+    ledger = CommLedger(n_shards)     # static comm-bytes sites (obs/comm)
 
     def hist_view(binned):
         idx = lax.axis_index(axis)
@@ -52,6 +54,7 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
         # shared SyncUpGlobalBestSplit allgather (ops/split.gather_best)
         idx = lax.axis_index(axis)
         res = res._replace(feature=res.feature + idx * f_local)
+        ledger.note_all_gather(res, site="fp.best_split")
         return gather_best(res, axis)
 
     inner = make_grower(
@@ -69,13 +72,16 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
                   P(None), P(axis)),
         out_specs=out_specs, check_vma=False)
 
+    jitted = jax.jit(f)
+
     def grow(binned, vals, feature_mask, num_bin, na_bin, na_bin_part=None,
              is_cat=None):
         if na_bin_part is None:
             na_bin_part = na_bin
         if is_cat is None:
             is_cat = jnp.zeros(num_bin.shape[0], bool)
-        return f(binned, vals, feature_mask, num_bin, na_bin, na_bin_part,
-                 is_cat)
+        return jitted(binned, vals, feature_mask, num_bin, na_bin,
+                      na_bin_part, is_cat)
 
-    return jax.jit(grow)
+    grow.comm = ledger
+    return grow

@@ -23,6 +23,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..grower import TreeArrays, make_grower
+from ..obs.comm import CommLedger
 from ..ops.split import SplitParams
 from ..utils.jax_compat import shard_map
 
@@ -66,6 +67,7 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
     """Jitted voting-parallel ``grow_tree`` over ``mesh`` (rows sharded)."""
 
     n_shards = mesh.shape[axis]
+    ledger = CommLedger(n_shards)     # static comm-bytes sites (obs/comm)
 
     def vote_reduce(h):
         f = h.shape[0]
@@ -73,21 +75,29 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         gains = _local_feature_gains(h, params, n_shards)
         _, local_top = lax.top_k(gains, k)              # [k]
         onehot = jnp.zeros(f, jnp.float32).at[local_top].add(1.0)
-        votes = lax.psum(onehot, axis)                  # [F] vote counts
+        votes = ledger.psum(onehot, axis,
+                            site="voting.votes")        # [F] vote counts
         # global top-2k by votes (ties: summed local gains)
-        gain_sum = lax.psum(jnp.where(jnp.isfinite(gains), gains, 0.0), axis)
+        gain_sum = ledger.psum(jnp.where(jnp.isfinite(gains), gains, 0.0),
+                               axis, site="voting.gains")
         score = votes * 1e12 + gain_sum
         k2 = min(2 * k, f)
         _, selected = lax.top_k(score, k2)
         sel_mask = jnp.zeros(f, bool).at[selected].set(True)
-        return lax.psum(h * sel_mask[:, None, None], axis)
+        # the ledger records the full zero-masked [F, B, 3] payload —
+        # the tensor XLA actually reduces; the reference's
+        # CopyLocalHistogram would ship only the voted k2/F slice
+        return ledger.psum(h * sel_mask[:, None, None], axis,
+                           site="voting.hist")
 
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
         hist_reduce=vote_reduce, subtract=False,
         # root totals must NOT come through the vote-filtered histogram
-        sum_reduce=lambda t: lax.psum(t, axis), jit=False)
+        sum_reduce=lambda t: ledger.psum(t, axis, site="voting.root_sum",
+                                         cadence="tree"),
+        jit=False)
 
     out_specs = TreeArrays(
         num_leaves=P(), split_feature=P(), threshold_bin=P(),
@@ -101,9 +111,13 @@ def make_voting_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
         in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
         out_specs=out_specs, check_vma=False)
 
+    jitted = jax.jit(f)
+
     def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
         if is_cat is None:
             is_cat = jnp.zeros(num_bin.shape[0], bool)
-        return f(binned, vals, feature_mask, num_bin, na_bin, na_bin, is_cat)
+        return jitted(binned, vals, feature_mask, num_bin, na_bin, na_bin,
+                      is_cat)
 
-    return jax.jit(grow)
+    grow.comm = ledger
+    return grow

@@ -43,3 +43,42 @@ def enable_persistent_cache(min_compile_secs: float = 0.5) -> str:
                       min_compile_secs)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return path
+
+
+def watch_compiles(metrics, tracer=None) -> bool:
+    """Feed XLA compile / compilation-cache events into an obs
+    MetricsRegistry (+ optional Tracer instants): compile durations as
+    a ``jax.compile_seconds`` histogram, cache hits/misses and other
+    compile-adjacent counters as ``jax.events{event=...}``.
+
+    Uses ``jax.monitoring``'s public listener hooks; listeners are
+    process-global and cannot be unregistered, so the registered
+    closures forward to whatever registry/tracer was CURRENT at
+    registration — callers register once per session (obs.ObsSession).
+    Returns False when the monitoring surface is unavailable."""
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        if "compil" not in event:
+            return
+        metrics.histogram("jax.compile_seconds",
+                          event=event).observe(duration)
+        if tracer is not None:
+            tracer.instant("jax_compile", event=event, seconds=duration)
+
+    def _on_event(event: str, **kw) -> None:
+        if "compil" not in event and "cache" not in event:
+            return
+        metrics.counter("jax.events", event=event).inc()
+        if tracer is not None and "cache" in event:
+            tracer.instant("jax_cache", event=event)
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        return False
+    return True

@@ -20,6 +20,14 @@ class Log:
     """Log::Debug/Info/Warning/Fatal (log.h)."""
     level: int = 1  # -1 fatal only, 0 +warning, 1 +info, 2 +debug
 
+    @classmethod
+    def set_verbosity(cls, verbosity: int) -> None:
+        """Map a Config ``verbosity`` (alias ``verbose``) to the level,
+        with reference semantics (config.h / Log::ResetLogLevel): <0
+        fatal-only, 0 warnings, 1 info, >=2 debug."""
+        v = int(verbosity)
+        cls.level = -1 if v < 0 else min(v, 2)
+
     @staticmethod
     def _emit(msg: str, py_level: int) -> None:
         if _callback is not None:

@@ -16,12 +16,18 @@ from typing import Dict, Optional
 
 
 class Timer:
-    """Accumulating named-scope timer (Common::Timer analog)."""
+    """Accumulating named-scope timer (Common::Timer analog).
+
+    The exit-time summary is registered LAZILY — on the first recorded
+    stat while enabled — so merely importing this module (or running
+    with telemetry off) never prints at interpreter exit; ``enabled``
+    is switched on by the obs subsystem (obs.ObsSession) or manually."""
 
     def __init__(self):
         self.stats: Dict[str, float] = collections.defaultdict(float)
         self.counts: Dict[str, int] = collections.defaultdict(int)
         self.enabled = False
+        self._atexit_armed = False
 
     def start(self, name: str) -> float:
         return time.perf_counter()
@@ -29,6 +35,9 @@ class Timer:
     def stop(self, name: str, t0: float) -> None:
         self.stats[name] += time.perf_counter() - t0
         self.counts[name] += 1
+        if self.enabled and not self._atexit_armed:
+            self._atexit_armed = True
+            atexit.register(self.print_summary)
 
     def print_summary(self) -> None:
         if not self.enabled or not self.stats:
@@ -39,7 +48,6 @@ class Timer:
 
 
 global_timer = Timer()
-atexit.register(global_timer.print_summary)
 
 
 class FunctionTimer:

@@ -49,12 +49,17 @@ class TestLog:
     def test_callback_sink(self):
         msgs = []
         register_log_callback(lambda m: msgs.append(m))
+        # the level is process-global and driven by Config verbosity
+        # (reference semantics) — pin it for the assertion
+        old = Log.level
+        Log.level = 1
         try:
             Log.info("hello")
             Log.warning("warn")
             assert any("hello" in m for m in msgs)
             assert any("warn" in m for m in msgs)
         finally:
+            Log.level = old
             register_log_callback(None)
 
     def test_fatal_raises(self):

@@ -58,11 +58,14 @@ def amortized(make_one, R):
 
 
 def timeit(fn, *args, reps=3):
-    jax.block_until_ready(fn(*args))
+    # obs.trace.fence, NOT block_until_ready: the latter returns early
+    # on the axon backend (PROFILE.md methodology / docs/Observability.md)
+    from lightgbm_tpu.obs.trace import fence
+    fence(fn(*args))
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        fence(fn(*args))
         ts.append(time.perf_counter() - t0)
     return min(ts)
 
@@ -170,7 +173,8 @@ def main():
     rng = np.random.RandomState(0)
     binned = jnp.asarray(rng.randint(0, B, size=(n, f), dtype=np.uint8))
     vals = jnp.asarray(rng.randn(n, 3).astype(np.float32))
-    jax.block_until_ready((binned, vals))
+    from lightgbm_tpu.obs.trace import fence
+    fence((binned, vals))
     print(f"n={n} f={f} B={B} R={R}; flops/pass = {2*3*n*f*B/1e9:.1f} GFLOP",
           file=sys.stderr, flush=True)
 

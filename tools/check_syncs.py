@@ -1,0 +1,135 @@
+"""Sync lint: flag raw host-sync calls in the library hot paths.
+
+PROFILE.md measured ~67 ms per blocking host round trip on a tunneled
+TPU — a stray ``jax.device_get`` / ``block_until_ready`` / ``.item()``
+in the training path is a silent 60+ ms/iteration regression, and
+``block_until_ready`` additionally *lies* on the axon backend (returns
+with work still queued), so even intentional fences must go through
+``obs.trace.fence``.  This lint keeps both properties true structurally:
+
+- every raw sync call in ``lightgbm_tpu/`` (outside ``obs/trace.py``,
+  the one module allowed to own the primitive) must be listed in
+  ``tools/sync_allowlist.txt``;
+- the allowlist pins (file, exact stripped source line), so MOVING a
+  legitimate sync is cheap (re-pin) but ADDING one is a conscious act.
+
+Comments and string literals are ignored (tokenize-based), so
+documentation may mention the calls freely.
+
+Run standalone (``python tools/check_syncs.py``; exit 1 on findings) or
+via tier-1 (tests/test_observability.py calls ``find_raw_syncs``).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "lightgbm_tpu")
+ALLOWLIST = os.path.join(REPO, "tools", "sync_allowlist.txt")
+
+# the module that owns the fence primitive; everything inside may sync
+EXEMPT = {os.path.join("lightgbm_tpu", "obs", "trace.py")}
+
+_SYNC_RE = re.compile(
+    r"device_get\s*\(|block_until_ready\b|\.item\s*\(\s*\)")
+
+
+def _code_lines(path: str) -> Dict[int, str]:
+    """line number -> source line, with comment and string tokens
+    blanked out so docs/docstrings never trigger the lint."""
+    with open(path, "rb") as f:
+        src = f.read()
+    text = src.decode("utf-8")
+    lines = text.splitlines()
+    drop: List[Tuple[int, int, int, int]] = []
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.STRING):
+                drop.append((*tok.start, *tok.end))
+    except tokenize.TokenError:
+        pass                     # partial file: lint what parsed
+    out = {i + 1: ln for i, ln in enumerate(lines)}
+    for (r0, c0, r1, c1) in drop:
+        for r in range(r0, r1 + 1):
+            ln = out.get(r, "")
+            a = c0 if r == r0 else 0
+            b = c1 if r == r1 else len(ln)
+            out[r] = ln[:a] + " " * (b - a) + ln[b:]
+    return out
+
+
+def load_allowlist(path: str = ALLOWLIST) -> Set[Tuple[str, str]]:
+    """Entries are ``relative/path.py | exact stripped source line``."""
+    out: Set[Tuple[str, str]] = set()
+    try:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw or raw.startswith("#"):
+                    continue
+                rel, _, line = raw.partition("|")
+                out.add((rel.strip(), line.strip()))
+    except OSError:
+        pass
+    return out
+
+
+def find_raw_syncs(root: str = PACKAGE,
+                   allowlist_path: str = ALLOWLIST) -> List[str]:
+    """All unallowlisted raw sync call sites, as
+    ``path:lineno: stripped line`` strings (empty list = lint green).
+    Also reports allowlist entries that no longer match anything, so
+    the list cannot rot."""
+    allow = load_allowlist(allowlist_path)
+    used: Set[Tuple[str, str]] = set()
+    findings: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            if rel in EXEMPT:
+                continue
+            for lineno, code in sorted(_code_lines(path).items()):
+                if not _SYNC_RE.search(code):
+                    continue
+                # the allowlist pins the ORIGINAL stripped line text
+                with open(path) as f:
+                    stripped = f.read().splitlines()[lineno - 1].strip()
+                key = (rel, stripped)
+                if key in allow:
+                    used.add(key)
+                    continue
+                findings.append(f"{rel}:{lineno}: {stripped}")
+    for key in sorted(allow - used):
+        findings.append(f"stale allowlist entry (no matching line): "
+                        f"{key[0]} | {key[1]}")
+    return findings
+
+
+def main() -> int:
+    findings = find_raw_syncs()
+    if findings:
+        print("sync lint: raw device_get/block_until_ready/.item() "
+              "outside obs.trace.fence:", file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        print(f"\n{len(findings)} finding(s).  Route fences through "
+              "lightgbm_tpu.obs.trace.fence, or pin a genuinely "
+              "necessary sync in tools/sync_allowlist.txt",
+              file=sys.stderr)
+        return 1
+    print("sync lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,40 +1,31 @@
 """Per-phase wall-clock attribution of one training iteration on real TPU.
 
-VERDICT r2 task 1: the bench's own FLOP arithmetic says the histogram matmul
-is tens of ms, but steady state was 850 ms/iter.  This script replicates
-``GBDTModel.train_one_iter``'s phases with explicit ``block_until_ready``
-fences so every millisecond is attributed to a named phase:
+VERDICT r2 task 1 / PROFILE.md §1: attribute every millisecond of a
+steady-state iteration to a named phase.  Since the obs subsystem this
+script is a THIN consumer: it enables ``telemetry=true`` on the booster
+and reads the per-phase spans the training loop itself emits
+(grad / grow / fetch / score, models/gbdt.py) — the same spans a
+production run records — plus a couple of raw-latency probes timed with
+``obs.trace.timed_fenced``.
 
-  grad      objective get_gradients (device)
-  vals      stack g/h/w (device)
-  grow      the jitted tree grower (device, includes all splits)
-  fetch     jax.device_get of the small tree arrays (host round trip)
-  hosttree  Tree.from_arrays + leaf-value numpy work (host)
-  score     leaf-gather score update (device)
+All fencing goes through ``obs.trace.fence`` (the device_get-of-a-scalar
+trick): ``jax.block_until_ready`` is NOT trustworthy on the axon backend
+(PROFILE.md methodology note — it can return with work still queued).
 
-It also measures the raw tunnel round-trip latency (tiny-op device_get) to
-separate dispatch/transfer latency from compute.  Output: a table on stderr,
-reproduced in PROFILE.md (the reference's global_timer discipline,
-/root/reference/include/LightGBM/utils/common.h:978).
+Output: a table on stderr + the JSONL trace (convertible to Perfetto
+via ``python -c "from lightgbm_tpu.obs.trace import jsonl_to_chrome;
+jsonl_to_chrome('profile_iter_trace.jsonl', 'trace.json')"``).
 
 Run: python tools/profile_iter.py [n_rows] [num_leaves]
 """
 
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
-
-
-def bench_phase(fn, iters=10):
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        fn()
-        ts.append(time.perf_counter() - t0)
-    return min(ts), sum(ts) / len(ts)
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
@@ -50,29 +41,31 @@ def main():
 
     import jax
     import jax.numpy as jnp
+
+    from lightgbm_tpu.obs.trace import Tracer, fence, timed_fenced
+
     devs = jax.devices()
     print(f"devices={devs}", file=sys.stderr)
 
-    # raw tunnel round-trip: dispatch + fetch of a 4-byte scalar
-    one = jnp.float32(1.0) + 0.0
-    jax.block_until_ready(one)
-    t_rt_min, t_rt_avg = bench_phase(
-        lambda: jax.device_get(jnp.float32(1.0) + one), iters=20)
-    print(f"tunnel round-trip (scalar op + device_get): "
+    tracer = Tracer(sink_path="profile_iter_trace.jsonl")
+
+    # raw tunnel round-trip: dispatch + fetch of a 4-byte scalar — the
+    # latency floor every blocking call pays (PROFILE.md §1)
+    one = fence(jnp.float32(1.0) + 0.0)
+    t_rt_min, t_rt_avg = timed_fenced(
+        lambda: jnp.float32(1.0) + one, iters=20, tracer=tracer,
+        name="tunnel_roundtrip")
+    print(f"tunnel round-trip (scalar op + fence): "
           f"min {t_rt_min*1e3:.1f} ms avg {t_rt_avg*1e3:.1f} ms",
           file=sys.stderr)
-
-    # dispatch-only latency (async, no fetch)
-    t_d_min, t_d_avg = bench_phase(
-        lambda: (jnp.float32(1.0) + one).block_until_ready(), iters=20)
-    print(f"blocking tiny dispatch: min {t_d_min*1e3:.1f} ms "
-          f"avg {t_d_avg*1e3:.1f} ms", file=sys.stderr)
 
     import lightgbm_tpu as lgb
 
     params = {"objective": "binary", "num_leaves": num_leaves,
               "learning_rate": 0.1, "max_bin": 63, "min_data_in_leaf": 20,
-              "verbosity": 0}
+              "verbosity": 0, "telemetry": True,
+              "telemetry_trace_file": "profile_iter_trace.jsonl",
+              "fused_chunk": 0}   # per-iteration path: that's what we attribute
     ds = lgb.Dataset(x, label=y, params=params)   # bin at the CLAIMED max_bin
     ds.construct()
     bst = lgb.Booster(params=params, train_set=ds)
@@ -83,78 +76,38 @@ def main():
     bst.update()
     print(f"compile+iter1: {time.perf_counter()-t0:.1f} s", file=sys.stderr)
 
-    # now phase-by-phase, repeated
-    from lightgbm_tpu.tree_model import Tree
-    from lightgbm_tpu.predict_device import round_up_pow2
-
-    phases = {k: [] for k in ("grad", "vals", "grow", "fetch", "hosttree",
-                              "score", "total")}
+    # steady-state reps: the training loop's own phase spans do the
+    # attribution — no replicated pipeline, no hand-rolled fences
     reps = 8
+    obs = m._obs
+    skip = {k: len(obs.tracer.durations(k))
+            for k in ("grad", "grow", "fetch", "score")}
+    t0 = time.perf_counter()
     for _ in range(reps):
-        t_all0 = time.perf_counter()
-
-        t0 = time.perf_counter()
-        g, h = m.objective.get_gradients(m.score[:, 0])
-        jax.block_until_ready((g, h))
-        phases["grad"].append(time.perf_counter() - t0)
-
-        t0 = time.perf_counter()
-        w = jnp.ones(m.num_data, jnp.float32)
-        vals = jnp.stack([g * w, h * w, w], axis=1)
-        jax.block_until_ready(vals)
-        phases["vals"].append(time.perf_counter() - t0)
-
-        t0 = time.perf_counter()
-        gkw = {}
-        if m._ic_grow is not None:
-            gkw["is_cat"] = m._ic_grow
-        fmask = jnp.asarray(m._feature_mask())
-        arrays = m.grower(m.binned_dev, vals, fmask, m._nb_grow,
-                          m._na_grow, **gkw)
-        jax.block_until_ready(arrays)
-        phases["grow"].append(time.perf_counter() - t0)
-
-        t0 = time.perf_counter()
-        small = arrays._replace(leaf_of_row=arrays.num_leaves)
-        host = jax.device_get(small)._replace(leaf_of_row=arrays.leaf_of_row)
-        phases["fetch"].append(time.perf_counter() - t0)
-
-        t0 = time.perf_counter()
-        nl = int(host.num_leaves)
-        leaf_values = np.asarray(host.leaf_value, np.float64).copy()
-        leaf_values *= m.learning_rate
-        ht = Tree.from_arrays(host, m.train_set.used_features,
-                              m.train_set.bin_mappers)
-        ht.leaf_value = leaf_values[:max(nl, 1)].copy()
-        steps = round_up_pow2(max(ht.max_depth(), 1))
-        phases["hosttree"].append(time.perf_counter() - t0)
-
-        t0 = time.perf_counter()
-        lv_dev = jnp.asarray(leaf_values, jnp.float32)
-        delta = jnp.take(lv_dev, arrays.leaf_of_row)
-        score = m.score.at[:, 0].add(delta)
-        jax.block_until_ready(score)
-        phases["score"].append(time.perf_counter() - t0)
-        m.score = score
-
-        phases["total"].append(time.perf_counter() - t_all0)
+        bst.update()
+    fence(m.score)
+    total = time.perf_counter() - t0
 
     print(f"\nper-phase (over {reps} reps), n={n} leaves={num_leaves}:",
           file=sys.stderr)
-    total_min = sum(min(v) for k, v in phases.items() if k != "total")
-    for k, v in phases.items():
+    phase_sum = 0.0
+    for k in ("grad", "grow", "fetch", "score"):
+        v = obs.tracer.durations(k)[skip[k]:]
+        if not v:
+            continue
+        phase_sum += min(v)
         print(f"  {k:9s} min {min(v)*1e3:8.1f} ms   avg "
               f"{np.mean(v)*1e3:8.1f} ms", file=sys.stderr)
-    print(f"  (sum of phase mins: {total_min*1e3:.1f} ms)", file=sys.stderr)
+    print(f"  (sum of phase mins: {phase_sum*1e3:.1f} ms; measured "
+          f"{total/reps*1e3:.1f} ms/iter)", file=sys.stderr)
 
-    # contrast: plain bst.update() loop (what bench.py measures)
-    t0 = time.perf_counter()
-    k = 5
-    for _ in range(k):
-        bst.update()
-    np.asarray(m.score)
-    print(f"\nplain bst.update() x{k}: {(time.perf_counter()-t0)/k*1e3:.1f} "
-          f"ms/iter", file=sys.stderr)
+    snap = bst.telemetry_finish()
+    it = snap.get("train.iterations", {}).get("value", 0)
+    isec = snap.get("train.iter_seconds", {})
+    if isec.get("count"):
+        print(f"\nmetrics: {it:g} iters, "
+              f"mean {isec['sum']/isec['count']*1e3:.1f} ms/iter; "
+              f"trace -> profile_iter_trace.jsonl", file=sys.stderr)
 
 
 if __name__ == "__main__":

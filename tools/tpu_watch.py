@@ -31,14 +31,26 @@ import time
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(_DIR)
+sys.path.insert(0, REPO)
 BENCH = os.path.join(REPO, "bench.py")
 CAPTURE = os.path.join(REPO, "BENCH_TPU_CAPTURE.jsonl")
 PROBE_OUT = os.path.join(_DIR, ".tpu_watch_probe.out")
 LOG = os.path.join(_DIR, "tpu_watch.log")
+TRACE = os.path.join(_DIR, "tpu_watch_trace.jsonl")
 
 POLL_S = 20
 PRIMARY_TIMEOUT = 900
 EXTRAS_TIMEOUT = 900
+
+# structured sibling of the text log: every probe wait / retry / bench
+# child becomes a span or instant in an obs JSONL trace, so a whole
+# round's tunnel behavior loads in Perfetto (obs.trace.jsonl_to_chrome).
+# pid=0 is REQUIRED: the default would call jax.process_index(), whose
+# backend init is itself a TPU claim — the watcher must never touch the
+# tunnel its probe children exist to wait on
+from lightgbm_tpu.obs.trace import Tracer  # noqa: E402
+
+tracer = Tracer(sink_path=TRACE, pid=0)
 
 
 def log(msg: str) -> None:
@@ -46,6 +58,7 @@ def log(msg: str) -> None:
     print(line, flush=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
+    tracer.instant("watch_log", msg=msg)
 
 
 def spawn_probe() -> subprocess.Popen:
@@ -95,6 +108,7 @@ def run_bench_child(mode: str, timeout: int) -> bool:
                _BENCH_POINTS_FILE=CAPTURE)
     log(f"running bench child '{mode}' (budget {timeout}s, not killed "
         "on overrun)...")
+    span = tracer.span(f"bench_child:{mode}", budget_s=timeout)
     err_path = os.path.join(_DIR, f".tpu_watch_{mode}.err")
     with open(err_path, "w") as err_f:
         p = subprocess.Popen([sys.executable, BENCH], env=env,
@@ -106,6 +120,8 @@ def run_bench_child(mode: str, timeout: int) -> bool:
             break
         time.sleep(5)
     if p.poll() is None:
+        span.args["outcome"] = "parked"
+        span.end()
         log(f"child '{mode}' still running after {timeout}s — left "
             "parked (claim holder; killing it would wedge the relay)")
         return False
@@ -114,6 +130,8 @@ def run_bench_child(mode: str, timeout: int) -> bool:
             tail = f.read()[-1500:]
     except OSError:
         tail = ""
+    span.args["outcome"] = f"rc={p.returncode}"
+    span.end()
     log(f"child '{mode}' rc={p.returncode}; stderr tail:\n{tail}")
     return p.returncode == 0
 
@@ -134,6 +152,7 @@ def main() -> None:
     log(f"watch start; capture -> {CAPTURE}")
     probe = spawn_probe()
     t_probe = time.time()
+    probe_span = tracer.span("probe_wait")
     retry_backoff = 60
     relayed_retries = set()
     while time.time() < deadline:
@@ -150,6 +169,8 @@ def main() -> None:
                 relayed_retries.add(ln)
                 log(f"probe backoff: {ln}")
         if "PROBE_OK" in out:
+            probe_span.args["outcome"] = "granted"
+            probe_span.end()
             log(f"claim landed after {time.time() - t_probe:.0f}s: "
                 f"{out.strip().splitlines()[-1]}")
             ok = run_bench_child("primary", PRIMARY_TIMEOUT)
@@ -164,12 +185,15 @@ def main() -> None:
             # claim, so replacing it is safe; back off so a hard-down
             # relay isn't hammered
             tail = out.strip().splitlines()[-1] if out.strip() else "(empty)"
+            probe_span.args["outcome"] = f"exited rc={probe.returncode}"
+            probe_span.end()
             log(f"probe exited rc={probe.returncode} without a grant "
                 f"({tail!r}); respawning in {retry_backoff}s")
             time.sleep(retry_backoff)
             retry_backoff = min(retry_backoff * 2, 1800)
             probe = spawn_probe()
             t_probe = time.time()
+            probe_span = tracer.span("probe_wait")
         elif int(time.time() - t_probe) % 600 < POLL_S:
             log(f"still waiting on claim ({time.time() - t_probe:.0f}s; "
                 "orphan parked, tunnel presumed wedged)")
