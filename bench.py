@@ -361,6 +361,26 @@ def child_extras() -> None:
         _record_point("dp_owner_shard_hist_bytes_per_leaf",
                       error=f"{type(e).__name__}: {e}"[:200])
 
+    # serving microbench (ISSUE 4 / tools/bench_serve.py): in-process
+    # serve stack (micro-batcher + bucketed predictor engine) driven by
+    # concurrent clients — rows/s and client-observed p50/p99 latency.
+    # Keyed-payload point: the keys fold into extras as serve_rows_per_s
+    # / serve_p99_ms etc.
+    try:
+        sys.path.insert(0, os.path.join(_DIR, "tools"))
+        import bench_serve
+        sp = bench_serve.run_bench(
+            duration_s=2.0 if cpu else 4.0, clients=4,
+            rows_per_request=64,
+            n_train=5_000 if cpu else 50_000)
+        _record_point("serve", cpu=cpu,
+                      **{k: v for k, v in sp.items()
+                         if k in ("rows_per_s", "p50_ms", "p99_ms",
+                                  "requests", "batch_occupancy_mean",
+                                  "compile_bound")})
+    except Exception as e:
+        _record_point("serve", error=f"{type(e).__name__}: {e}"[:200])
+
     # comm wire bytes per boosting iteration (obs/comm.py static model,
     # same math the telemetry counters use at train time): the in-flight
     # number arXiv:1706.08359 instruments to validate scaling — one

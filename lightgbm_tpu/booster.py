@@ -54,6 +54,23 @@ def _objective_from_string(s: str) -> Dict[str, Any]:
     return out
 
 
+def _finalize_score(score: np.ndarray, k: int, objective, average_output,
+                    t0: int, t1: int, raw_score: bool) -> np.ndarray:
+    """The ONE score-finalization tail shared by every predict path
+    (host walk, bucketed engine, serve engine): RF averaging over the
+    predicted range, then the objective's output conversion.  Byte-
+    identical results across paths depend on this being a single
+    definition — do not inline copies."""
+    if average_output and t1 > t0:
+        score /= (t1 - t0) // k
+    if not raw_score and objective is not None:
+        import jax.numpy as jnp
+        conv = objective.convert_output(
+            jnp.asarray(score if k > 1 else score[:, 0]))
+        return np.asarray(conv)
+    return score if k > 1 else score[:, 0]
+
+
 class _IntAndCall(int):
     """int that also answers the reference's METHOD spelling — basic.py
     exposes ``bst.current_iteration()`` as a method while this framework
@@ -86,6 +103,10 @@ class Booster:
         self._num_tree_per_iteration = 1
         self._average_output = False
         self._max_feature_idx = 0
+        # bucketed predictor engine (serve/engine.py), built lazily by
+        # predict(); False = engine refused this model (don't retry),
+        # None = not built yet.  Dropped on every model mutation.
+        self._engine_cache = None
 
         if model_file is not None:
             with open(model_file) as f:
@@ -221,6 +242,52 @@ class Booster:
     def _sync_trees(self) -> None:
         self.trees = self._model.models
         self.tree_weights = self._model.tree_weights
+        self._drop_predict_cache()
+
+    def _drop_predict_cache(self) -> None:
+        """Invalidate the cached predictor engine after any model
+        mutation (training step, rollback, merge, shuffle, refit)."""
+        self._engine_cache = None
+
+    # auto mode's build threshold: rows x trees below this predicts
+    # faster through the host walk than through a fresh XLA trace
+    _ENGINE_AUTO_WORK = 1 << 16
+
+    def predict_engine(self, n_rows: Optional[int] = None):
+        """The bucketed SoA predictor engine for the CURRENT model
+        (serve/engine.py), or None when ``predict_bucketed`` rules it
+        out or the model shape is unsupported.  ``predict_bucketed``:
+        ``auto`` (default) builds the engine once rows x trees is large
+        enough to repay the trace — an engine already built (a larger
+        earlier call, or serving installing its own) serves ALL sizes;
+        ``true`` always builds; ``false`` never.  Cached until the
+        model mutates."""
+        mode = str(getattr(self.config, "predict_bucketed",
+                           "auto")).lower()
+        if mode in ("false", "0", "no", "off", "-"):
+            return None
+        eng = getattr(self, "_engine_cache", None)
+        if eng is False:
+            return None
+        if eng is not None and len(eng.trees) != len(self.trees):
+            eng = None                    # stale (defensive; _sync_trees
+            #                               normally drops it)
+        if eng is None:
+            if mode == "auto" and (n_rows is None or n_rows *
+                                   max(len(self.trees), 1)
+                                   < self._ENGINE_AUTO_WORK):
+                return None
+            from .serve.engine import EngineUnsupported, PredictorEngine
+            try:
+                eng = PredictorEngine.from_booster(self)
+            except EngineUnsupported as e:
+                from .utils.log import Log
+                Log.debug(f"bucketed predict disabled for this model: "
+                          f"{e}")
+                self._engine_cache = False
+                return None
+            self._engine_cache = eng
+        return eng
 
     @property
     def current_iteration(self) -> "_IntAndCall":
@@ -436,6 +503,31 @@ class Booster:
             num_iteration = len(self.trees) // k
         t0, t1 = start_iteration * k, min((start_iteration + num_iteration) * k,
                                           len(self.trees))
+        if n == 0 and not pred_contrib:
+            # zero-row input: the empty result of the correct shape and
+            # dtype, with NO device work (tracing a zero-row program per
+            # batch shape is pure waste) — consistent with the
+            # predict_disable_shape_check contract: the feature-count
+            # check above already ran
+            if pred_leaf:
+                return np.zeros((0, t1 - t0), np.int32)
+            if not raw_score and self.objective is not None:
+                # converted output rides through f32 (convert_output)
+                return np.zeros((0, k) if k > 1 else (0,), np.float32)
+            return np.zeros((0, k) if k > 1 else (0,), np.float64)
+        # bucketed engine path (serve/engine.py): device traversal under
+        # a power-of-two-bucket compile cache; leaf routing and score
+        # accumulation are byte-identical to the host walk below
+        eng = self.predict_engine(n) if not pred_contrib \
+            and not pred_early_stop else None
+        if eng is not None:
+            leaves = eng.leaf_ids(x)
+            if pred_leaf:
+                return np.ascontiguousarray(leaves[:, t0:t1])
+            score = eng.raw_scores(x, t0, t1, leaves=leaves)
+            return _finalize_score(score, k, self.objective,
+                                   self._average_output, t0, t1,
+                                   raw_score)
         if pred_leaf:
             out = np.zeros((n, t1 - t0), np.int32)
             for i, ti in enumerate(range(t0, t1)):
@@ -462,14 +554,8 @@ class Booster:
                     part = np.partition(score, -2, axis=1)
                     margin = part[:, -1] - part[:, -2]
                 active &= margin < pred_early_stop_margin
-        if self._average_output and t1 > t0:
-            score /= (t1 - t0) // k
-        if not raw_score and self.objective is not None:
-            import jax.numpy as jnp
-            conv = self.objective.convert_output(
-                jnp.asarray(score if k > 1 else score[:, 0]))
-            return np.asarray(conv)
-        return score if k > 1 else score[:, 0]
+        return _finalize_score(score, k, self.objective,
+                               self._average_output, t0, t1, raw_score)
 
     # ------------------------------------------------------------------
     def to_c_code(self, num_iteration: Optional[int] = None) -> str:
@@ -855,6 +941,7 @@ class Booster:
                 w = m.tree_weights[ti] if ti < len(m.tree_weights) else 1.0
                 score[:, ti % k] += w * t.leaf_value[leaf_preds[:, ti]]
             m.score = jnp.asarray(score, jnp.float32)
+        self._drop_predict_cache()   # leaf values changed in place
         return self
 
     def _merge_from(self, other: "Booster") -> None:
@@ -893,6 +980,7 @@ class Booster:
         else:
             self.trees[:0] = new_trees
             self.tree_weights[:0] = new_weights
+            self._drop_predict_cache()
 
     def _shuffle_models(self, start_iter: int, end_iter: int) -> None:
         """LGBM_BoosterShuffleModels (c_api.h:512; GBDT::ShuffleModels):
@@ -950,6 +1038,7 @@ class Booster:
         else:
             self.tree_weights[:] = _permute(list(self.tree_weights))
             self.trees[:] = new_trees
+            self._drop_predict_cache()
 
     def reset_training_data(self, train_set) -> "Booster":
         """LGBM_BoosterResetTrainingData (c_api.h:540): keep the model,

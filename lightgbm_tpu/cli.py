@@ -22,13 +22,25 @@ from .engine import train as train_fn
 from . import callback as cb
 
 
+_BARE_TASKS = ("train", "predict", "refit", "serve", "save_binary",
+               "convert_model")
+
+
 def _load_params(argv: List[str]) -> Dict[str, str]:
+    # a bare leading task word is sugar for task=<word>:
+    # ``python -m lightgbm_tpu serve input_model=model.txt``
+    argv = list(argv)
+    task_token = None
+    if argv and "=" not in argv[0] and argv[0] in _BARE_TASKS:
+        task_token = argv.pop(0)
     params = kv2map(argv)
     conf_path = params.pop("config", params.pop("config_file", None))
     if conf_path:
         file_params = load_config_file(conf_path)
         file_params.update(params)   # CLI overrides file (application.cpp:50)
         params = file_params
+    if task_token is not None:
+        params["task"] = task_token  # the bare word outranks the file
     return params
 
 
@@ -42,6 +54,8 @@ def run(argv: List[str]) -> int:
         return _task_predict(cfg, params)
     if task == "refit":
         return _task_refit(cfg, params)
+    if task == "serve":
+        return _task_serve(cfg, params)
     if task == "save_binary":
         return _task_save_binary(cfg, params)
     if task == "convert_model":
@@ -96,6 +110,32 @@ def _task_predict(cfg: Config, params: Dict) -> int:
         num_iteration=cfg.num_iteration_predict)
     np.savetxt(cfg.output_result, np.asarray(pred), delimiter="\t", fmt="%g")
     print(f"Saved predictions to {cfg.output_result}")
+    return 0
+
+
+def _task_serve(cfg: Config, params: Dict) -> int:
+    """``task=serve`` / ``python -m lightgbm_tpu serve``: long-lived
+    HTTP prediction service (docs/Serving.md).  The model comes from
+    ``input_model``, or — with ``resume=true`` — from the newest
+    complete snapshot of ``output_model`` (hot-reloadable at runtime
+    via ``POST /reload``)."""
+    from .serve.server import Server, start_http
+    server = Server(params)
+    frontend = start_http(server, cfg.serve_host, cfg.serve_port,
+                          background=False)
+    health = server.health()
+    model = health.get("model") or {}
+    print(f"serving {model.get('source', '<none>')} "
+          f"(version {model.get('version')}) on "
+          f"http://{cfg.serve_host}:{frontend.port} — "
+          f"/predict /healthz /metrics /reload", flush=True)
+    try:
+        frontend.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.close()
+        server.close()
     return 0
 
 
@@ -175,6 +215,7 @@ def refit_leaf_values(booster: Booster, leaf_preds: np.ndarray,
                                      + (1.0 - decay) * new_out
                                      * tree.shrinkage)
         score[:, kk] += tree.leaf_value[leaves]
+    booster._drop_predict_cache()        # leaf values changed in place
     return booster
 
 

@@ -128,6 +128,14 @@ _PARAMS: Dict[str, tuple] = {
     "predict_leaf_index": (bool, False, ["is_predict_leaf_index", "leaf_index"]),
     "predict_contrib": (bool, False, ["is_predict_contrib", "contrib"]),
     "predict_disable_shape_check": (bool, False, []),
+    # route Booster.predict through the bucketed SoA predictor engine
+    # (serve/engine.py): batch sizes round up to power-of-two buckets so
+    # repeated predicts with varying row counts stay within a bounded
+    # compile cache.  auto = engine when rows x trees is large enough to
+    # repay the trace (or when serving already built one); true =
+    # always; false = legacy host-tree walk.  Results are byte-identical
+    # on every path
+    "predict_bucketed": (str, "auto", []),
     "pred_early_stop": (bool, False, []),
     "pred_early_stop_freq": (int, 10, []),
     "pred_early_stop_margin": (float, 10.0, []),
@@ -241,6 +249,30 @@ _PARAMS: Dict[str, tuple] = {
     # through the init_model path (engine.py); never recorded in the
     # saved model's parameters section
     "resume": (bool, False, ["auto_resume"]),
+    # ---- serving (lightgbm_tpu/serve/, docs/Serving.md) ----
+    # micro-batch cap in rows: the batcher dispatches a batch as soon as
+    # this many rows are queued; also the engine's bucket cap, bounding
+    # XLA compiles per model to ~log2(serve_max_batch)
+    "serve_max_batch": (int, 1024, []),
+    # how long the first queued request holds the coalescing window open
+    # before the batch dispatches short of serve_max_batch
+    "serve_max_wait_ms": (float, 2.0, []),
+    # bounded queue size in ROWS: beyond it, submissions are rejected
+    # with an explicit retry-after (HTTP 429) instead of growing the
+    # backlog without bound
+    "serve_queue_rows": (int, 8192, ["serve_queue_size"]),
+    # smallest padded-batch bucket: tiny requests all share one compiled
+    # shape instead of one per power of two below it
+    "serve_min_bucket": (int, 16, []),
+    # retries for TRANSIENT device errors during a serve batch
+    # (utils/resilience.py classifier; programming errors never retry)
+    "serve_retries": (int, 2, []),
+    # opt-in: bin raw rows ON-DEVICE in f32 fused with the traversal —
+    # higher throughput, but rows tying a split threshold within f32
+    # rounding may bin differently from the exact (host f64) path
+    "serve_device_binning": (bool, False, []),
+    "serve_host": (str, "127.0.0.1", []),
+    "serve_port": (int, 7070, []),
     # ---- IO / task ----
     "task": (str, "train", ["task_type"]),
     "data": (str, "", ["train", "train_data", "train_data_file", "data_filename"]),
@@ -484,6 +516,28 @@ class Config:
                 and len(self.telemetry_profile_iters) not in (1, 2):
             raise ValueError(
                 "telemetry_profile_iters must be [start] or [start, count]")
+        pb = str(self.predict_bucketed).strip().lower()
+        if pb in ("true", "1", "+", "yes", "on"):
+            self.predict_bucketed = "true"
+        elif pb in ("false", "0", "-", "no", "off"):
+            self.predict_bucketed = "false"
+        elif pb == "auto":
+            self.predict_bucketed = "auto"
+        else:
+            raise ValueError(
+                f"predict_bucketed={self.predict_bucketed!r} must be "
+                "auto, true or false")
+        if self.serve_max_batch < 1:
+            raise ValueError("serve_max_batch must be >= 1")
+        if self.serve_max_wait_ms < 0:
+            raise ValueError("serve_max_wait_ms must be >= 0")
+        # the bucket floor can never exceed the batch cap, and the queue
+        # must hold at least one full batch (clamped, not rejected: both
+        # are derived sizing knobs)
+        self.serve_min_bucket = max(1, min(self.serve_min_bucket,
+                                           self.serve_max_batch))
+        self.serve_queue_rows = max(self.serve_queue_rows,
+                                    self.serve_max_batch)
         # verbosity drives the global log level with reference semantics
         # (config.h: <0 fatal-only, 0 warnings, 1 info, >=2 debug; the
         # reference's Config::Set calls Log::ResetLogLevel the same way)

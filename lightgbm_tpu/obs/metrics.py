@@ -92,6 +92,28 @@ class Histogram:
                 "max": self.max if self.count else None,
                 "buckets": list(self.buckets), "counts": list(self.counts)}
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (0..1) by linear interpolation inside
+        the containing bucket, clamped to the observed [min, max] (the
+        Prometheus ``histogram_quantile`` estimator).  None when empty.
+        Serving latency p50/p99 (serve/server.py /metrics) read this."""
+        if self.count <= 0:
+            return None
+        target = max(0.0, min(1.0, q)) * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target and c > 0:
+                lo = self.buckets[i - 1] if i > 0 else self.min
+                hi = self.buckets[i] if i < len(self.buckets) else self.max
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                if hi <= lo:
+                    return float(hi)
+                frac = (target - (cum - c)) / c
+                return float(lo + (hi - lo) * frac)
+        return float(self.max)
+
 
 class MetricsRegistry:
     """Lazy instrument registry; thread-safe creation, lock-free use."""

@@ -76,3 +76,82 @@ def round_up_pow2(x: int) -> int:
     while p < x:
         p *= 2
     return p
+
+
+# ---------------------------------------------------------------------------
+# Whole-ensemble traversal (serving / bucketed Booster.predict)
+# ---------------------------------------------------------------------------
+
+# traces of the forest-traversal program, incremented while TRACING only
+# (the increment is a Python side effect, so it runs once per new jit
+# cache entry, never per execution).  tests/test_serve.py reads this to
+# prove the bucketed compile cache bounds XLA compiles.
+_FOREST_TRACES = [0]
+
+
+def forest_trace_count() -> int:
+    """Number of times ``traverse_forest_binned`` has been traced (==
+    compiled) in this process."""
+    return _FOREST_TRACES[0]
+
+
+def traverse_forest_binned(binned, split_feature, threshold_bin,
+                           default_left, left_child, right_child, na_bin,
+                           is_cat_node, cat_index, cat_table, *, steps: int):
+    """Leaf index for every (row, tree) pair: ``binned`` [N, F] ->
+    [N, T] int32.
+
+    The whole-ensemble counterpart of :func:`traverse_tree_binned` used
+    by ``serve/engine.py``: per-node arrays are stacked [T, M] (M = max
+    nodes per tree, padded), every row walks all T trees one level per
+    step, finished rows carry their ~leaf id unchanged.  Categorical
+    decisions go through a compact rank table — ``cat_index`` maps a
+    node to its row of ``cat_table`` [C, B] (0 = category in the node's
+    left set, 1 = not), numerical nodes use the bin id itself as the
+    rank (model-derived binning makes ``bin(x) <= threshold_bin`` exact,
+    see serve/engine.py).  Call under ``jax.jit`` with ``steps`` static;
+    a module-level trace counter records each compilation.
+    """
+    _FOREST_TRACES[0] += 1
+    n = binned.shape[0]
+    t = split_feature.shape[0]
+    node = jnp.zeros((n, t), jnp.int32)
+    tree_ids = jnp.arange(t, dtype=jnp.int32)[None, :]
+
+    def body(_, node):
+        internal = node >= 0
+        nid = jnp.maximum(node, 0)
+        f = split_feature[tree_ids, nid]                       # [N, T]
+        v = jnp.take_along_axis(binned, f, axis=1)             # [N, T]
+        cat = is_cat_node[tree_ids, nid]
+        nb = na_bin[f]
+        is_na = (nb >= 0) & (v == nb) & (~cat)
+        rank = jnp.where(cat, cat_table[cat_index[tree_ids, nid], v], v)
+        go_left = jnp.where(is_na, default_left[tree_ids, nid],
+                            rank <= threshold_bin[tree_ids, nid])
+        nxt = jnp.where(go_left, left_child[tree_ids, nid],
+                        right_child[tree_ids, nid])
+        return jnp.where(internal, nxt, node)
+
+    node = lax.fori_loop(0, steps, body, node)
+    return (~node).astype(jnp.int32)
+
+
+def bin_rows_device(x, thresholds, na_bin, zero_bin):
+    """On-device model-derived binning of raw NUMERICAL rows (f32).
+
+    ``thresholds`` [F, B] is each feature's sorted split-threshold table
+    padded with +inf; the bin id is the count of thresholds < x, i.e.
+    ``searchsorted(T_f, x, 'left')`` as a comparison-sum.  NaNs map to
+    ``na_bin[f]`` when the feature reserves one (missing-type NaN nodes)
+    and to ``zero_bin[f]`` (the bin of 0.0) otherwise — the reference
+    Predictor's NaN->0 conversion.  f32 comparisons: rows whose value
+    ties a threshold within f32 rounding may bin differently from the
+    exact host (f64) path — this feeds the opt-in approximate
+    ``serve_device_binning`` mode only (docs/Serving.md)."""
+    xf = x.astype(jnp.float32)
+    isnan = jnp.isnan(xf)
+    bins = jnp.sum(xf[:, :, None] > thresholds[None, :, :],
+                   axis=-1).astype(jnp.int32)
+    fallback = jnp.where(na_bin >= 0, na_bin, zero_bin)[None, :]
+    return jnp.where(isnan, fallback, bins)

@@ -1,0 +1,39 @@
+"""Batched low-latency inference serving subsystem.
+
+Turns a trained model into a long-lived, high-throughput prediction
+service (ROADMAP north star: "serving heavy traffic"), following the
+dedicated-GBDT-inference-engine literature (arXiv:2011.02022 SoA tree
+layouts, arXiv:1706.08359 batched device traversal):
+
+- ``engine``    compiled predictor: the ensemble flattened ONCE into
+                SoA device arrays, rows binned into model-derived bin
+                space, whole-forest traversal under a bucketed compile
+                cache (batch sizes round up to power-of-two buckets so
+                XLA compiles are bounded by log2(max_batch)).
+- ``batcher``   micro-batching queue: a worker thread coalesces
+                concurrent requests under ``serve_max_batch`` /
+                ``serve_max_wait_ms`` with a bounded queue and explicit
+                reject-with-retry-after backpressure.
+- ``registry``  versioned model registry with atomic hot swap;
+                in-flight requests finish on the version they started
+                on.
+- ``server``    in-process ``Server`` API + stdlib-only HTTP frontend
+                (``/predict``, ``/healthz``, ``/metrics``), wired into
+                the obs subsystem (``serve.*`` metrics, per-batch
+                spans).
+
+See docs/Serving.md.
+"""
+
+from __future__ import annotations
+
+from .batcher import BacklogFull, MicroBatcher
+from .engine import EngineUnsupported, PredictorEngine
+from .registry import ModelRegistry, NoModelError, ServedModel
+from .server import Server, start_http
+
+__all__ = [
+    "BacklogFull", "EngineUnsupported", "MicroBatcher", "ModelRegistry",
+    "NoModelError", "PredictorEngine", "ServedModel", "Server",
+    "start_http",
+]

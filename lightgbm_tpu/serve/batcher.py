@@ -1,0 +1,277 @@
+"""Micro-batching request queue with bounded backpressure.
+
+One worker thread coalesces concurrent prediction requests into device
+batches: the first queued request opens a window of ``max_wait_ms``;
+everything that arrives before the window closes (or before the batch
+reaches ``max_batch`` rows) rides the same traversal.  The queue is
+BOUNDED in rows — when ``queue_rows`` of work is already pending,
+``submit`` rejects immediately with :class:`BacklogFull` carrying a
+``retry_after_ms`` estimate instead of growing without bound (the
+explicit reject-with-retry-after discipline; HTTP maps it to 429 +
+``Retry-After``).  Transient device errors retry through
+``utils/resilience.RetryPolicy``; non-transient errors fail only the
+requests of the batch that hit them.
+
+Metrics (when a registry is attached): ``serve.queue_depth`` gauge
+(rows), ``serve.batch_rows`` / ``serve.batch_occupancy`` /
+``serve.latency`` histograms, ``serve.requests`` / ``serve.rows`` /
+``serve.rejected`` / ``serve.errors`` counters, plus a ``serve.batch``
+span per dispatched batch on the tracer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.resilience import (RetryPolicy, is_retryable_device_error,
+                                retry_call)
+
+
+class BacklogFull(RuntimeError):
+    """Queue is at capacity; retry after ``retry_after_ms``."""
+
+    def __init__(self, retry_after_ms: float, depth_rows: int):
+        super().__init__(
+            f"serve queue full ({depth_rows} rows pending); "
+            f"retry in ~{retry_after_ms:.0f} ms")
+        self.retry_after_ms = float(retry_after_ms)
+        self.depth_rows = int(depth_rows)
+
+
+class BatcherClosed(RuntimeError):
+    """The batcher was shut down before this request completed."""
+
+
+class PredictionFuture:
+    """Handle for one submitted request; ``result()`` blocks."""
+
+    __slots__ = ("_event", "_value", "_exc", "info", "t_submit")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+        self.info: dict = {}
+        self.t_submit = time.perf_counter()
+
+    def _set(self, value, info: Optional[dict] = None) -> None:
+        self._value = value
+        if info:
+            self.info = info
+        self._event.set()
+
+    def _set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("prediction did not complete in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _Item:
+    __slots__ = ("rows", "future")
+
+    def __init__(self, rows: np.ndarray, future: PredictionFuture):
+        self.rows = rows
+        self.future = future
+
+
+class MicroBatcher:
+    """Coalesce concurrent requests into bounded device batches.
+
+    ``predict_fn(rows) -> (outputs, info)``: outputs is an array whose
+    leading axis matches ``rows`` (sliced back per request), ``info`` a
+    small dict attached to every future of the batch (model version
+    etc.); a plain-array return is also accepted.
+    """
+
+    def __init__(self, predict_fn: Callable, *, max_batch: int = 1024,
+                 max_wait_ms: float = 2.0, queue_rows: int = 8192,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 metrics=None, tracer=None):
+        self.predict_fn = predict_fn
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self.queue_rows = max(self.max_batch, int(queue_rows))
+        self.retry_policy = retry_policy
+        self.metrics = metrics
+        self.tracer = tracer
+        self._queue: List[_Item] = []
+        self._depth_rows = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self.batches_dispatched = 0
+        self._worker = threading.Thread(target=self._run,
+                                        name="lgbtpu-serve-batcher",
+                                        daemon=True)
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, rows: np.ndarray) -> PredictionFuture:
+        """Enqueue one request; raises :class:`BacklogFull` when the
+        bounded queue cannot take it.  A 1-D vector is one row; anything
+        not coercible to a 2-D array is rejected HERE, where the error
+        reaches only the offending caller — malformed rows must never
+        travel into a shared batch where they would poison the other
+        requests riding it."""
+        rows = np.asarray(rows)
+        if rows.ndim == 1:
+            rows = rows.reshape(1, -1)
+        if rows.ndim != 2:
+            raise ValueError(f"rows must be 2-D, got {rows.ndim}-D")
+        n = len(rows)
+        fut = PredictionFuture()
+        with self._lock:
+            if self._closed:
+                raise BatcherClosed("batcher is closed")
+            if self._depth_rows + n > self.queue_rows and self._queue:
+                pending_batches = -(-self._depth_rows // self.max_batch)
+                retry_ms = pending_batches * max(
+                    self.max_wait_ms_effective(), 1.0)
+                if self.metrics is not None:
+                    self.metrics.counter("serve.rejected").inc()
+                raise BacklogFull(retry_ms, self._depth_rows)
+            self._queue.append(_Item(rows, fut))
+            self._depth_rows += n
+            if self.metrics is not None:
+                self.metrics.gauge("serve.queue_depth").set(
+                    self._depth_rows)
+            self._wake.notify()
+        return fut
+
+    def max_wait_ms_effective(self) -> float:
+        return self.max_wait_s * 1e3
+
+    @property
+    def depth_rows(self) -> int:
+        with self._lock:
+            return self._depth_rows
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Graceful shutdown: new submissions are rejected immediately,
+        already-queued work drains, and only requests the worker could
+        not drain within ``timeout`` fail with :class:`BatcherClosed`."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._wake.notify_all()
+        self._worker.join(timeout)
+        with self._lock:
+            leftovers, self._queue = self._queue, []
+            self._depth_rows = 0
+        for item in leftovers:
+            item.future._set_exception(BatcherClosed("batcher closed"))
+
+    # -- worker side -------------------------------------------------------
+    def _collect(self) -> List[_Item]:
+        """Block for the next batch: wait for a first request, then hold
+        the window open until ``max_wait_s`` passes or ``max_batch``
+        rows are in hand.  An oversized single request becomes its own
+        batch (the engine chunks internally)."""
+        with self._lock:
+            while not self._queue and not self._closed:
+                self._wake.wait()
+            if not self._queue:
+                return []
+            deadline = self._queue[0].future.t_submit + self.max_wait_s
+            while not self._closed:
+                have = sum(len(i.rows) for i in self._queue)
+                if have >= self.max_batch:
+                    break
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    break
+                self._wake.wait(left)
+            batch: List[_Item] = []
+            rows = 0
+            while self._queue:
+                nxt = len(self._queue[0].rows)
+                if batch and (rows + nxt > self.max_batch
+                              or self._queue[0].rows.shape[1]
+                              != batch[0].rows.shape[1]):
+                    # width mismatch (a request sized for a different
+                    # model width): never concatenated into this batch —
+                    # it opens the NEXT batch and fails alone if invalid
+                    break
+                item = self._queue.pop(0)
+                batch.append(item)
+                rows += nxt
+            self._depth_rows -= rows
+            if self.metrics is not None:
+                self.metrics.gauge("serve.queue_depth").set(
+                    self._depth_rows)
+            return batch
+
+    def _dispatch(self, batch: List[_Item]) -> None:
+        n = sum(len(i.rows) for i in batch)
+        span = (self.tracer.span("serve.batch", rows=n,
+                                 requests=len(batch))
+                if self.tracer is not None else None)
+        try:
+            # concatenation INSIDE the guarded region: any surviving
+            # shape surprise fails this batch's futures, never the
+            # worker thread
+            rows = (batch[0].rows if len(batch) == 1
+                    else np.concatenate([i.rows for i in batch], axis=0))
+            out = retry_call(self.predict_fn, rows,
+                             policy=self.retry_policy,
+                             classify=is_retryable_device_error,
+                             label="serve.predict")
+            outputs, info = out if isinstance(out, tuple) else (out, {})
+            outputs = np.asarray(outputs)
+        except BaseException as e:
+            if span is not None:
+                span.end()
+            if self.metrics is not None:
+                self.metrics.counter("serve.errors").inc(len(batch))
+            for item in batch:
+                item.future._set_exception(e)
+            return
+        if span is not None:
+            span.end()
+        self.batches_dispatched += 1
+        now = time.perf_counter()
+        if self.metrics is not None:
+            self.metrics.counter("serve.requests").inc(len(batch))
+            self.metrics.counter("serve.rows").inc(n)
+            self.metrics.histogram("serve.batch_rows").observe(n)
+            self.metrics.histogram("serve.batch_occupancy").observe(
+                min(1.0, n / self.max_batch))
+            for item in batch:
+                self.metrics.histogram("serve.latency").observe(
+                    now - item.future.t_submit)
+        lo = 0
+        for item in batch:
+            hi = lo + len(item.rows)
+            item.future._set(outputs[lo:hi], dict(info))
+            lo = hi
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                with self._lock:
+                    if self._closed:
+                        return
+                continue
+            try:
+                self._dispatch(batch)
+            except BaseException as e:       # noqa: BLE001 — the worker
+                # must outlive ANY single batch; _dispatch already fails
+                # the batch's own futures, this is the last-ditch belt
+                for item in batch:
+                    if not item.future.done():
+                        item.future._set_exception(e)

@@ -1,0 +1,430 @@
+"""Compiled predictor engine: SoA ensemble + bucketed compile cache.
+
+The training loop already owns a fast binned traversal
+(``predict_device.traverse_tree_binned``), but nothing exposed it to
+callers at serving time — ``Booster.predict`` walked host trees row
+group by row group.  This module flattens a trained ensemble ONCE into
+stacked structure-of-arrays device tensors (the SoA layout
+arXiv:2011.02022 and arXiv:1706.08359 identify as where GBDT inference
+throughput lives) and runs the whole-forest traversal
+(``predict_device.traverse_forest_binned``) under a compile cache keyed
+by (model fingerprint, padded batch bucket):
+
+- **Model-derived binning.**  Each feature's bin table is the sorted
+  set of split thresholds the ENSEMBLE actually uses (not the training
+  ``BinMapper`` — a loaded model file has no mappers).  With
+  ``bin(x) = searchsorted(T_f, x, side="left")`` the reference decision
+  ``x <= threshold`` is EXACTLY ``bin(x) <= index(threshold)``, so
+  traversal over bins reproduces ``tree_model.Tree.predict_leaf``
+  bit-for-bit.  Binning runs host-side in float64 — the one stage that
+  cannot run in f32 without breaking bit-exact parity (a raw value that
+  ties a threshold after f32 rounding may cross it); the opt-in
+  ``serve_device_binning`` mode moves it on-device in f32 for
+  throughput at the cost of exactness on such ties.
+- **Bucketed batches.**  Row counts round up to power-of-two buckets
+  (floored at ``min_bucket``, capped at ``max_batch`` when set), so the
+  number of distinct traversal shapes — and therefore XLA compiles —
+  is bounded by ~log2(max_batch) per model, measured by
+  ``predict_device.forest_trace_count`` and surfaced via
+  ``compile_stats()`` / ``utils/compile_cache.watch_compiles``.
+- **Exact scores.**  The device returns leaf ids; leaf values are
+  accumulated HOST-side in float64 in tree order — the same float ops,
+  in the same order, as ``Booster.predict``, so engine scores (and the
+  serve path built on them) are byte-identical to the reference
+  predictor, linear trees and DART/RF tree weights included.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..predict_device import round_up_pow2
+
+_CAT_BIT = 1
+_DEFAULT_LEFT_BIT = 2
+_MISSING_SHIFT = 2
+_ALWAYS_LEFT = np.int32(1 << 30)   # stump sentinel threshold: rank <= this
+
+
+class EngineUnsupported(ValueError):
+    """Model shape the SoA engine cannot represent (callers fall back to
+    the host-tree path)."""
+
+
+class _FeatureTable:
+    """Per-feature model-derived bin table."""
+
+    __slots__ = ("kind", "thresholds", "cats", "miss_nan", "na_bin",
+                 "num_bins")
+
+    def __init__(self, kind: str):
+        self.kind = kind                    # "num" | "cat" | "unused"
+        self.thresholds = np.empty(0, np.float64)
+        self.cats = np.empty(0, np.int64)
+        self.miss_nan = False               # any node routes NaN by flag
+        self.na_bin = -1
+        self.num_bins = 1
+
+
+def _feature_tables(trees, num_features: int) -> List[_FeatureTable]:
+    tables = [_FeatureTable("unused") for _ in range(num_features)]
+    thr_acc: Dict[int, List[np.ndarray]] = {}
+    cat_acc: Dict[int, set] = {}
+    miss_acc: Dict[int, set] = {}
+    for t in trees:
+        n = t.num_nodes()
+        if n == 0:
+            continue
+        sf = t.split_feature[:n]
+        dt = t.decision_type[:n]
+        is_cat = (dt & _CAT_BIT) != 0
+        miss = (dt >> _MISSING_SHIFT) & 3
+        for f in np.unique(sf[~is_cat]):
+            m = (sf == f) & ~is_cat
+            thr_acc.setdefault(int(f), []).append(t.threshold[:n][m])
+            # miss kind 2 (NaN) routes NaN by the node's default_left
+            # flag; kinds 0/1 convert NaN to 0.0 first
+            # (tree_model._decide) — record which behaviors appear
+            miss_acc.setdefault(int(f), set()).update(
+                {2} if (miss[m] == 2).any() else set())
+            miss_acc[int(f)].update(
+                {0} if (miss[m] != 2).any() else set())
+        for i in np.nonzero(is_cat)[0]:
+            f = int(sf[i])
+            ci = int(t.threshold[i])
+            lo, hi = t.cat_boundaries[ci], t.cat_boundaries[ci + 1]
+            words = t.cat_threshold[lo:hi]
+            cset = cat_acc.setdefault(f, set())
+            for wi, w in enumerate(words):
+                w = int(w)
+                while w:
+                    b = w & -w
+                    cset.add(32 * wi + b.bit_length() - 1)
+                    w ^= b
+    for f, chunks in thr_acc.items():
+        if f in cat_acc:
+            raise EngineUnsupported(
+                f"feature {f} has both numerical and categorical splits")
+        if len(miss_acc[f]) > 1:
+            # a trained model never mixes NaN-routing and NaN-converting
+            # nodes on one feature (they come from one BinMapper); a
+            # hand-merged model could — refuse rather than mispredict
+            raise EngineUnsupported(
+                f"feature {f} mixes NaN-routing and NaN-converting "
+                "split nodes")
+        tab = tables[f]
+        tab.kind = "num"
+        tab.miss_nan = miss_acc[f] == {2}
+        tab.thresholds = np.unique(np.concatenate(chunks))
+        # bins 0..len(T) from searchsorted, +1 reserved NaN bin when the
+        # feature routes NaN by flag
+        tab.na_bin = len(tab.thresholds) + 1 if tab.miss_nan else -1
+        tab.num_bins = len(tab.thresholds) + (2 if tab.miss_nan else 1)
+    for f, cset in cat_acc.items():
+        tab = tables[f]
+        tab.kind = "cat"
+        tab.cats = np.asarray(sorted(cset), np.int64)
+        tab.num_bins = len(tab.cats) + 1        # + unseen/NaN sentinel
+    return tables
+
+
+# one shared jitted traversal for ALL engines: two engines whose SoA
+# shapes match (common in tests and A/B model versions) reuse the same
+# compile-cache entries — the model arrays travel as call arguments, so
+# the cache key is (shapes, steps), never the model content
+_shared_traverse = None
+
+
+def _traverse_jit():
+    global _shared_traverse
+    if _shared_traverse is None:
+        import jax
+        from ..predict_device import traverse_forest_binned
+        _shared_traverse = jax.jit(traverse_forest_binned,
+                                   static_argnames=("steps",))
+    return _shared_traverse
+
+
+class PredictorEngine:
+    """One trained ensemble, flattened for batched device traversal.
+
+    Thread-safe: ``leaf_ids``/``raw_scores``/``predict`` may be called
+    concurrently (the jit cache and host accumulation are functional;
+    the bucket ledger is lock-guarded).
+    """
+
+    def __init__(self, trees, tree_weights, num_class: int,
+                 num_features: int, objective=None,
+                 average_output: bool = False, *,
+                 max_batch: Optional[int] = None, min_bucket: int = 16,
+                 fingerprint: Optional[str] = None):
+        import jax.numpy as jnp
+
+        self.trees = list(trees)
+        self.tree_weights = list(tree_weights)
+        self.num_class = max(1, int(num_class))
+        self.num_features = int(num_features)
+        self.objective = objective
+        self.average_output = bool(average_output)
+        self.max_batch = int(max_batch) if max_batch else None
+        self.min_bucket = max(1, int(min_bucket))
+        if self.max_batch is not None:
+            self.min_bucket = min(self.min_bucket, self.max_batch)
+        if self.num_features < 1:
+            raise EngineUnsupported("model has no features")
+
+        self.tables = _feature_tables(self.trees, self.num_features)
+        self._build_soa()
+        self.fingerprint = fingerprint or self._fingerprint()
+        self._lock = threading.Lock()
+        self._buckets_seen: Dict[int, int] = {}
+
+        d = self._dev = {}
+        for name in ("split_feature", "threshold_bin", "left_child",
+                     "right_child", "cat_index"):
+            d[name] = jnp.asarray(getattr(self, "_" + name), jnp.int32)
+        d["default_left"] = jnp.asarray(self._default_left, jnp.bool_)
+        d["is_cat_node"] = jnp.asarray(self._is_cat_node, jnp.bool_)
+        d["cat_table"] = jnp.asarray(self._cat_table, jnp.int32)
+        d["na_bin"] = jnp.asarray(self._na_bin, jnp.int32)
+        self._bin_dev = None               # lazy device-binning tables
+
+    def _traverse(self, binned):
+        d = self._dev
+        return _traverse_jit()(
+            binned, d["split_feature"], d["threshold_bin"],
+            d["default_left"], d["left_child"], d["right_child"],
+            d["na_bin"], d["is_cat_node"], d["cat_index"],
+            d["cat_table"], steps=self._steps)
+
+    # -- construction ------------------------------------------------------
+    def _build_soa(self) -> None:
+        trees = self.trees
+        T = len(trees)
+        M = max([t.num_nodes() for t in trees] + [1])
+        L = max([t.num_leaves for t in trees] + [1])
+        self._split_feature = np.zeros((T, M), np.int32)
+        self._threshold_bin = np.zeros((T, M), np.int32)
+        self._default_left = np.zeros((T, M), bool)
+        self._left_child = np.full((T, M), -1, np.int32)
+        self._right_child = np.full((T, M), -1, np.int32)
+        self._is_cat_node = np.zeros((T, M), bool)
+        self._cat_index = np.zeros((T, M), np.int32)
+        self.leaf_values = np.zeros((T, L), np.float64)
+        self._na_bin = np.asarray([tab.na_bin for tab in self.tables],
+                                  np.int32)
+        cat_rows: List[np.ndarray] = []
+        max_cat_bins = max([tab.num_bins for tab in self.tables
+                            if tab.kind == "cat"] + [1])
+        depth = 1
+        for ti, t in enumerate(trees):
+            n = t.num_nodes()
+            self.leaf_values[ti, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+            if t.num_leaves <= 1:
+                # stump: the padded root routes every row (NaN included)
+                # to leaf 0
+                self._threshold_bin[ti, 0] = _ALWAYS_LEFT
+                self._default_left[ti, 0] = True
+                continue
+            depth = max(depth, t.max_depth())
+            sf = t.split_feature[:n]
+            dt = t.decision_type[:n]
+            is_cat = (dt & _CAT_BIT) != 0
+            self._split_feature[ti, :n] = sf
+            self._default_left[ti, :n] = (dt & _DEFAULT_LEFT_BIT) != 0
+            self._left_child[ti, :n] = t.left_child[:n]
+            self._right_child[ti, :n] = t.right_child[:n]
+            self._is_cat_node[ti, :n] = is_cat
+            for f in np.unique(sf[~is_cat]):
+                tab = self.tables[int(f)]
+                m = (sf == f) & ~is_cat
+                self._threshold_bin[ti, :n][m] = np.searchsorted(
+                    tab.thresholds, t.threshold[:n][m], side="left")
+            for i in np.nonzero(is_cat)[0]:
+                tab = self.tables[int(sf[i])]
+                # rank row over the feature's model-wide category table:
+                # 0 = in this node's left set, 1 = not (sentinel bin —
+                # unseen / negative / NaN — is always 1 -> right, the
+                # _cat_contains fall-through)
+                row = np.ones(max_cat_bins, np.int32)
+                if len(tab.cats):
+                    contained = t._cat_contains(
+                        int(t.threshold[i]), tab.cats.astype(np.float64))
+                    row[:len(tab.cats)] = np.where(contained, 0, 1)
+                self._cat_index[ti, i] = len(cat_rows)
+                cat_rows.append(row)
+                # threshold_bin stays 0: go left iff rank <= 0
+        self._cat_table = (np.stack(cat_rows) if cat_rows
+                           else np.zeros((1, 1), np.int32))
+        self._steps = round_up_pow2(depth)
+
+    def _fingerprint(self) -> str:
+        h = hashlib.sha256()
+        h.update(f"{len(self.trees)}:{self.num_class}:"
+                 f"{self.num_features}".encode())
+        for arr in (self._split_feature, self._threshold_bin,
+                    self._left_child, self.leaf_values,
+                    np.asarray(self.tree_weights, np.float64)):
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()[:16]
+
+    # -- binning -----------------------------------------------------------
+    def bin_rows(self, x: np.ndarray) -> np.ndarray:
+        """Exact host-side (f64) model-derived binning: [n, F] float ->
+        [n, F] int32 in each feature's own bin space."""
+        x = np.asarray(x, np.float64)
+        out = np.zeros(x.shape, np.int32)
+        for f, tab in enumerate(self.tables):
+            if tab.kind == "num":
+                v = x[:, f]
+                isnan = np.isnan(v)
+                if tab.miss_nan:
+                    out[:, f] = np.where(
+                        isnan, tab.na_bin,
+                        np.searchsorted(tab.thresholds,
+                                        np.where(isnan, 0.0, v), "left"))
+                else:
+                    out[:, f] = np.searchsorted(
+                        tab.thresholds, np.where(isnan, 0.0, v), "left")
+            elif tab.kind == "cat" and len(tab.cats):
+                v = x[:, f]
+                # trunc-toward-zero + NaN/inf -> -1, exactly
+                # tree_model._decide's CategoricalDecision input mapping
+                iv = np.where(np.isfinite(v), v, -1.0).astype(np.int64)
+                pos = np.searchsorted(tab.cats, iv)
+                pos = np.clip(pos, 0, len(tab.cats) - 1)
+                out[:, f] = np.where(tab.cats[pos] == iv, pos,
+                                     len(tab.cats))
+        return out
+
+    def _bucket(self, n: int) -> int:
+        b = max(self.min_bucket, round_up_pow2(max(n, 1)))
+        if self.max_batch is not None:
+            b = min(b, round_up_pow2(self.max_batch))
+        return b
+
+    def _device_bin_tables(self):
+        import jax.numpy as jnp
+        if self._bin_dev is None:
+            B = max([len(t.thresholds) for t in self.tables] + [1])
+            thr = np.full((self.num_features, B), np.inf, np.float32)
+            zero_bin = np.zeros(self.num_features, np.int32)
+            for f, tab in enumerate(self.tables):
+                if tab.kind == "num":
+                    thr[f, :len(tab.thresholds)] = tab.thresholds
+                    zero_bin[f] = np.searchsorted(tab.thresholds, 0.0,
+                                                  "left")
+                elif tab.kind == "cat":
+                    raise EngineUnsupported(
+                        "device binning supports numerical features only")
+            self._bin_dev = (jnp.asarray(thr), jnp.asarray(zero_bin))
+        return self._bin_dev
+
+    # -- traversal ---------------------------------------------------------
+    def leaf_ids(self, x: np.ndarray,
+                 device_binning: bool = False) -> np.ndarray:
+        """Leaf index per (row, tree): [n, F] raw floats -> [n, T] int32.
+        Batches above the bucket cap are processed in max-bucket chunks;
+        zero rows never touch the device."""
+        import jax
+        x = np.asarray(x, np.float64)
+        n = len(x)
+        T = len(self.trees)
+        if n == 0 or T == 0:
+            return np.zeros((n, T), np.int32)
+        cap = self._bucket(n)
+        chunks = []
+        for lo in range(0, n, cap):
+            sub = x[lo:lo + cap]
+            bucket = self._bucket(len(sub))
+            with self._lock:
+                self._buckets_seen[bucket] = \
+                    self._buckets_seen.get(bucket, 0) + 1
+            if device_binning:
+                thr, zero_bin = self._device_bin_tables()
+                from ..predict_device import bin_rows_device
+                xpad = np.zeros((bucket, self.num_features), np.float32)
+                xpad[:len(sub)] = sub
+                binned = bin_rows_device(jax.numpy.asarray(xpad), thr,
+                                         self._dev["na_bin"], zero_bin)
+            else:
+                pad = np.zeros((bucket, self.num_features), np.int32)
+                pad[:len(sub)] = self.bin_rows(sub)
+                binned = jax.numpy.asarray(pad)
+            # the serve hot path's ONE device fetch: leaf ids are the
+            # data the host accumulation genuinely needs
+            out = jax.device_get(self._traverse(binned))
+            chunks.append(np.asarray(out[:len(sub)], np.int32))
+        return np.concatenate(chunks, axis=0)
+
+    # -- scoring -----------------------------------------------------------
+    def raw_scores(self, x: np.ndarray, t0: int = 0,
+                   t1: Optional[int] = None,
+                   leaves: Optional[np.ndarray] = None,
+                   device_binning: bool = False) -> np.ndarray:
+        """[n, num_class] float64 raw scores over trees [t0, t1) —
+        float-op-for-float-op identical to ``Booster.predict``'s host
+        accumulation (tree order, f64, tree_weights applied)."""
+        x = np.asarray(x, np.float64)
+        t1 = len(self.trees) if t1 is None else t1
+        k = self.num_class
+        if leaves is None:
+            leaves = self.leaf_ids(x, device_binning=device_binning)
+        score = np.zeros((len(x), k))
+        for ti in range(t0, t1):
+            t = self.trees[ti]
+            w = self.tree_weights[ti] if ti < len(self.tree_weights) else 1.0
+            lv = leaves[:, ti]
+            vals = t.linear_leaf_outputs(lv, x) if t.is_linear \
+                else t.leaf_value[lv]
+            score[:, ti % k] += w * vals
+        return score
+
+    def predict(self, x, raw_score: bool = False,
+                device_binning: bool = False) -> np.ndarray:
+        """Full-model prediction with the ``Booster.predict`` output
+        contract (averaging for RF, objective output conversion — the
+        shared ``booster._finalize_score`` tail)."""
+        from ..booster import _finalize_score
+        x = np.asarray(x, np.float64)
+        k = self.num_class
+        n, t1 = len(x), len(self.trees)
+        if n == 0:
+            out_f32 = not raw_score and self.objective is not None
+            shape = (0, k) if k > 1 else (0,)
+            return np.zeros(shape, np.float32 if out_f32 else np.float64)
+        score = self.raw_scores(x, device_binning=device_binning)
+        return _finalize_score(score, k, self.objective,
+                               self.average_output, 0, t1, raw_score)
+
+    # -- introspection -----------------------------------------------------
+    def compile_stats(self) -> dict:
+        """Bucketed-compile-cache ledger: buckets used (with hit
+        counts), the bound on distinct traversal shapes, and the
+        process-wide forest trace counter
+        (``predict_device.forest_trace_count``)."""
+        from ..predict_device import forest_trace_count
+        with self._lock:
+            buckets = dict(sorted(self._buckets_seen.items()))
+        cap = self.max_batch or max(list(buckets) + [self.min_bucket])
+        import math
+        bound = int(math.ceil(math.log2(max(cap, 2)))) + 1
+        return {"fingerprint": self.fingerprint, "buckets": buckets,
+                "max_compiles_bound": bound,
+                "forest_traces_process": forest_trace_count(),
+                "steps": self._steps, "num_trees": len(self.trees)}
+
+    @classmethod
+    def from_booster(cls, booster, *, max_batch: Optional[int] = None,
+                     min_bucket: int = 16) -> "PredictorEngine":
+        """Flatten a ``Booster`` (live or loaded from a model file)."""
+        return cls(booster.trees, booster.tree_weights,
+                   booster._num_tree_per_iteration,
+                   booster.num_feature(),
+                   objective=getattr(booster, "objective", None),
+                   average_output=booster._average_output,
+                   max_batch=max_batch, min_bucket=min_bucket)

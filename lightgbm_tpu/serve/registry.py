@@ -1,0 +1,167 @@
+"""Versioned model registry with atomic hot swap.
+
+Serving must outlive any single model file: the registry holds
+(version -> :class:`ServedModel`) where each entry pairs a loaded
+``Booster`` with its compiled :class:`~.engine.PredictorEngine`, and an
+atomic "current" pointer.  ``activate`` swaps the pointer under a lock
+— a reader that already resolved :meth:`current` keeps its handle, so
+in-flight requests finish on the version they started on while new
+requests pick up the swap (the hot-reload contract, docs/Serving.md).
+
+Models load from model files / strings / live Boosters, or from
+``snapshot.py`` training snapshots: :meth:`load_snapshot` picks the
+newest snapshot of an ``output_model`` whose manifest is present and
+parseable (the manifest-written-last marker of a COMPLETE snapshot) —
+serving has no training dataset, so the params-signature and
+data-fingerprint checks that gate training auto-resume do not apply.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class NoModelError(RuntimeError):
+    """The registry has no active model."""
+
+
+class ServedModel:
+    """One immutable (version, booster, engine) serving unit."""
+
+    __slots__ = ("version", "booster", "engine", "source", "loaded_at")
+
+    def __init__(self, version: str, booster, engine, source: str):
+        self.version = version
+        self.booster = booster
+        self.engine = engine
+        self.source = source
+        self.loaded_at = time.time()
+
+    def describe(self) -> dict:
+        return {"version": self.version, "source": self.source,
+                "loaded_at": self.loaded_at,
+                "num_trees": len(self.booster.trees),
+                "num_class": self.booster._num_tree_per_iteration,
+                "num_features": self.booster.num_feature(),
+                "fingerprint": self.engine.fingerprint
+                if self.engine is not None else None}
+
+
+class ModelRegistry:
+    def __init__(self, *, max_batch: Optional[int] = None,
+                 min_bucket: int = 16, build_engine: bool = True):
+        self._models: Dict[str, ServedModel] = {}
+        self._current: Optional[ServedModel] = None
+        self._lock = threading.Lock()
+        self._next_version = 1
+        self._engine_opts = {"max_batch": max_batch,
+                             "min_bucket": min_bucket}
+        self._build_engine = build_engine
+
+    # -- loading -----------------------------------------------------------
+    def load(self, model_file: Optional[str] = None,
+             model_str: Optional[str] = None, booster=None,
+             version: Optional[str] = None, source: str = "",
+             activate: bool = True) -> str:
+        """Load one model (exactly one of file / string / booster),
+        register it, and (by default) atomically make it current."""
+        from ..booster import Booster
+        if sum(a is not None
+               for a in (model_file, model_str, booster)) != 1:
+            raise ValueError("load needs exactly one of model_file, "
+                             "model_str, booster")
+        if booster is None:
+            booster = Booster(model_file=model_file, model_str=model_str)
+            source = source or (model_file or "<model_str>")
+        else:
+            source = source or "<booster>"
+        engine = None
+        if self._build_engine:
+            from ..utils.log import Log
+            from .engine import EngineUnsupported, PredictorEngine
+            try:
+                engine = PredictorEngine.from_booster(booster,
+                                                      **self._engine_opts)
+            except EngineUnsupported as e:
+                # an engine-unsupported model is still SERVABLE — the
+                # batch path falls back to the host walk exactly like
+                # Booster.predict does; only the bucketed cache is lost
+                Log.warning(f"serve: bucketed engine unavailable for "
+                            f"{source} ({e}); serving via host walk")
+                booster._engine_cache = False
+            else:
+                # make this THE booster's predictor too: Booster.predict
+                # on the serve path then rides the same bucketed cache,
+                # and the engine's compile ledger (surfaced via
+                # /metrics) sees every batch
+                booster._engine_cache = engine
+        with self._lock:
+            if version is None:
+                version = f"v{self._next_version}"
+            self._next_version += 1
+            if version in self._models:
+                raise ValueError(f"model version {version!r} already "
+                                 "registered")
+            served = ServedModel(version, booster, engine, source)
+            self._models[version] = served
+            if activate or self._current is None:
+                self._current = served
+        return version
+
+    def load_snapshot(self, output_model: str,
+                      version: Optional[str] = None,
+                      activate: bool = True) -> str:
+        """Load the newest COMPLETE snapshot of ``output_model``
+        (manifest present + parseable, snapshot.py)."""
+        from ..snapshot import find_latest_complete_snapshot
+        found = find_latest_complete_snapshot(output_model)
+        if found is None:
+            raise FileNotFoundError(
+                f"no complete snapshot of {output_model!r} found")
+        it, path = found
+        return self.load(model_file=path, version=version,
+                         source=f"{path} (snapshot iter {it})",
+                         activate=activate)
+
+    # -- swap / lookup -----------------------------------------------------
+    def activate(self, version: str) -> None:
+        """Atomically point new requests at ``version``; handles already
+        resolved via :meth:`current` are unaffected."""
+        with self._lock:
+            if version not in self._models:
+                raise KeyError(f"unknown model version {version!r}")
+            self._current = self._models[version]
+
+    def current(self) -> ServedModel:
+        with self._lock:
+            if self._current is None:
+                raise NoModelError("no model loaded")
+            return self._current
+
+    def get(self, version: Optional[str] = None) -> ServedModel:
+        if version is None:
+            return self.current()
+        with self._lock:
+            try:
+                return self._models[version]
+            except KeyError:
+                raise KeyError(f"unknown model version {version!r}") \
+                    from None
+
+    def unload(self, version: str) -> None:
+        """Drop a non-current version (the current one must be swapped
+        away first)."""
+        with self._lock:
+            if self._current is not None \
+                    and self._current.version == version:
+                raise ValueError("cannot unload the current version; "
+                                 "activate another first")
+            self._models.pop(version, None)
+
+    def versions(self) -> List[dict]:
+        with self._lock:
+            cur = self._current.version if self._current else None
+            return [dict(m.describe(), current=(v == cur))
+                    for v, m in sorted(self._models.items())]

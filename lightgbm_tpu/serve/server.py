@@ -1,0 +1,300 @@
+"""Serving frontends: in-process ``Server`` API + stdlib HTTP endpoint.
+
+``Server`` wires the subsystem together: a :class:`~.registry.ModelRegistry`
+(initial model from a ``Booster``, a model file/string, or the newest
+complete training snapshot), a :class:`~.batcher.MicroBatcher` sized by
+the ``serve_*`` config params, and the PR-3 obs subsystem — ``serve.*``
+metrics always collect (they are host-side counters, no device syncs);
+spans/JSONL/profiler ride the usual ``telemetry`` switch.
+
+Predictions go through ``Booster.predict`` of the batch's resolved
+model version — which itself routes through the bucketed
+:class:`~.engine.PredictorEngine` — so serve results are byte-identical
+to a direct ``Booster.predict`` call on the same rows, micro-batch
+coalescing included (elementwise routing + per-row accumulation make
+batch composition invisible; tests/test_serve.py proves it across the
+objective/feature matrix).
+
+``start_http`` exposes the same Server over a stdlib-only
+``ThreadingHTTPServer``:
+
+- ``POST /predict``  ``{"rows": [[...], ...]}`` ->
+  ``{"predictions": ..., "model_version": ..., "num_rows": ...}``;
+  429 + ``Retry-After`` on backpressure, 400 on malformed input.
+- ``POST /reload``   ``{"model_file": ...}`` (or ``{"snapshot": out}``)
+  -> hot swap, in-flight requests finish on the old version.
+- ``GET /healthz``   liveness + current model version + queue depth.
+- ``GET /metrics``   deterministic JSON metrics snapshot
+  (``serve.latency`` quantiles included) + engine compile stats.
+
+CLI: ``python -m lightgbm_tpu serve input_model=model.txt`` (or
+``task=serve`` in a config file) — see cli.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log
+from ..utils.resilience import RetryPolicy
+from .batcher import BacklogFull, MicroBatcher
+from .registry import ModelRegistry, NoModelError
+
+
+class Server:
+    """Long-lived in-process prediction service."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 booster=None, model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.config = params if isinstance(params, Config) \
+            else Config(params or {})
+        cfg = self.config
+        from ..obs import MetricsRegistry, maybe_session
+        self.obs = maybe_session(cfg)
+        self.metrics = self.obs.metrics if self.obs is not None \
+            else MetricsRegistry()
+        self.tracer = self.obs.tracer if self.obs is not None else None
+        self.registry = ModelRegistry(
+            max_batch=cfg.serve_max_batch,
+            min_bucket=cfg.serve_min_bucket)
+        model_file = model_file or (cfg.input_model or None)
+        if booster is not None or model_file or model_str:
+            self.registry.load(model_file=model_file,
+                               model_str=model_str, booster=booster)
+        elif cfg.resume and cfg.output_model:
+            # serve the newest complete snapshot of a (possibly still
+            # running) training job
+            self.registry.load_snapshot(cfg.output_model)
+        self.batcher = MicroBatcher(
+            self._predict_batch,
+            max_batch=cfg.serve_max_batch,
+            max_wait_ms=cfg.serve_max_wait_ms,
+            queue_rows=cfg.serve_queue_rows,
+            # serve-scaled backoff: the bring-up defaults (1 s base)
+            # would stall the single worker for seconds on a path whose
+            # latency budget is serve_max_wait_ms
+            retry_policy=RetryPolicy(
+                max_attempts=max(1, cfg.serve_retries + 1),
+                base_delay_s=0.02, max_delay_s=0.25),
+            metrics=self.metrics, tracer=self.tracer)
+        self._t0 = time.time()
+        self._closed = False
+
+    # -- batch execution (worker thread) -----------------------------------
+    def _predict_batch(self, rows: np.ndarray) -> Tuple[np.ndarray, dict]:
+        served = self.registry.current()   # resolved per batch: requests
+        # already in this batch finish on it even if a reload lands now
+        if self.config.serve_device_binning and served.engine is not None:
+            out = served.engine.predict(rows, device_binning=True)
+        else:
+            out = served.booster.predict(rows)
+        return np.asarray(out), {"model_version": served.version}
+
+    # -- client surface ----------------------------------------------------
+    def predict(self, rows, timeout: Optional[float] = None) -> np.ndarray:
+        """Predict through the micro-batching queue; blocks for the
+        result.  Raises :class:`~.batcher.BacklogFull` under
+        backpressure."""
+        return self.submit(rows).result(timeout)
+
+    def submit(self, rows):
+        """Enqueue and return the :class:`PredictionFuture` (the
+        non-blocking form of :meth:`predict`)."""
+        span = (self.tracer.span("serve.request", rows=len(rows))
+                if self.tracer is not None else None)
+        fut = self.batcher.submit(np.asarray(rows, np.float64))
+        if span is not None:
+            span.end()
+        return fut
+
+    def reload(self, model_file: Optional[str] = None,
+               model_str: Optional[str] = None, booster=None,
+               snapshot: Optional[str] = None) -> str:
+        """Load a new model version and atomically swap it in; returns
+        the new version id."""
+        if snapshot is not None:
+            version = self.registry.load_snapshot(snapshot)
+        else:
+            version = self.registry.load(model_file=model_file,
+                                         model_str=model_str,
+                                         booster=booster)
+        Log.info(f"serve: activated model {version}")
+        return version
+
+    def health(self) -> dict:
+        try:
+            model = self.registry.current().describe()
+            status = "ok"
+        except NoModelError:
+            model, status = None, "no_model"
+        return {"status": status, "model": model,
+                "queue_depth_rows": self.batcher.depth_rows,
+                "uptime_s": round(time.time() - self._t0, 3),
+                "versions": self.registry.versions()}
+
+    def metrics_snapshot(self) -> dict:
+        snap = dict(self.metrics.snapshot())
+        lat = snap.get("serve.latency")
+        if lat and lat.get("count"):
+            from ..obs.metrics import Histogram
+            h = Histogram(tuple(lat["buckets"]))
+            h.counts, h.count = list(lat["counts"]), lat["count"]
+            h.sum, h.min, h.max = lat["sum"], lat["min"], lat["max"]
+            snap["serve.latency_quantiles"] = {
+                "p50_s": h.quantile(0.5), "p99_s": h.quantile(0.99)}
+        try:
+            engine = self.registry.current().engine
+            if engine is not None:
+                snap["serve.engine"] = engine.compile_stats()
+        except NoModelError:
+            pass
+        return snap
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.batcher.close()
+        if self.obs is not None:
+            self.obs.finish()
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend (stdlib only)
+# ---------------------------------------------------------------------------
+
+class HttpFrontend:
+    """Handle for a running HTTP frontend (``.port``, ``.close()``)."""
+
+    def __init__(self, httpd, thread: Optional[threading.Thread]):
+        self._httpd = httpd
+        self._thread = thread
+        self.host, self.port = httpd.server_address[:2]
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
+               background: bool = True) -> HttpFrontend:
+    """Expose ``server`` over HTTP; ``port=0`` picks a free port (read
+    it back from the returned handle)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):       # route through Log
+            Log.debug("serve-http: " + fmt % args)
+
+        def _send(self, code: int, payload: dict,
+                  headers: Optional[dict] = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, server.health())
+            elif self.path == "/metrics":
+                self._send(200, server.metrics_snapshot())
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, TypeError) as e:
+                self._send(400, {"error": f"bad JSON: {e}"})
+                return
+            if self.path == "/predict":
+                self._predict(req)
+            elif self.path == "/reload":
+                self._reload(req)
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def _predict(self, req: dict) -> None:
+            rows = req.get("rows")
+            if rows is None:
+                self._send(400, {"error": "missing 'rows'"})
+                return
+            try:
+                arr = np.asarray(rows, np.float64)
+                if arr.ndim == 1:
+                    arr = arr.reshape(1, -1)
+                if arr.ndim != 2:
+                    raise ValueError(f"rows must be 2-D, got "
+                                     f"{arr.ndim}-D")
+            except (ValueError, TypeError) as e:
+                self._send(400, {"error": f"bad rows: {e}"})
+                return
+            try:
+                fut = server.submit(arr)
+                pred = fut.result(timeout=req.get("timeout_s", 30.0))
+            except BacklogFull as e:
+                self._send(429, {"error": str(e),
+                                 "retry_after_ms": e.retry_after_ms},
+                           headers={"Retry-After": str(max(
+                               1, int(e.retry_after_ms / 1000 + 0.5)))})
+                return
+            except NoModelError as e:
+                self._send(503, {"error": str(e)})
+                return
+            except Exception as e:          # noqa: BLE001 — request-scoped
+                from ..basic import LightGBMError
+                # a malformed REQUEST (wrong feature count, bad shape)
+                # is the client's fault — 400, not 500; per-width batch
+                # coalescing guarantees it failed alone
+                code = 400 if isinstance(e, (ValueError, LightGBMError)) \
+                    else 500
+                self._send(code,
+                           {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {
+                "predictions": np.asarray(pred).tolist(),
+                "num_rows": int(len(arr)),
+                "model_version": fut.info.get("model_version")})
+
+        def _reload(self, req: dict) -> None:
+            try:
+                version = server.reload(
+                    model_file=req.get("model_file"),
+                    model_str=req.get("model_str"),
+                    snapshot=req.get("snapshot"))
+            except Exception as e:          # noqa: BLE001 — operator call
+                self._send(400,
+                           {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {"model_version": version})
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    thread = None
+    if background:
+        thread = threading.Thread(target=httpd.serve_forever,
+                                  name="lgbtpu-serve-http", daemon=True)
+        thread.start()
+    Log.info(f"serve: HTTP frontend on "
+             f"http://{httpd.server_address[0]}:"
+             f"{httpd.server_address[1]}")
+    return HttpFrontend(httpd, thread)

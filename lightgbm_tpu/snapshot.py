@@ -139,6 +139,31 @@ def prune_snapshots(output_model: str, keep: int) -> None:
                 pass
 
 
+def find_latest_complete_snapshot(output_model: str
+                                  ) -> Optional[Tuple[int, str]]:
+    """Newest snapshot of ``output_model`` whose manifest is present,
+    parseable and format-matching, as ``(iteration, model_path)`` — the
+    SERVING-side lookup (serve/registry.py hot reload): unlike
+    :func:`find_latest_snapshot`, no params-signature or
+    data-fingerprint check applies because a serving process has
+    neither; the manifest-written-last marker alone distinguishes a
+    complete snapshot from an interrupted write."""
+    for it, path in _list_snapshots(output_model):
+        try:
+            with open(path + ".manifest.json") as f:
+                man = json.load(f)
+        except (OSError, ValueError) as e:
+            Log.warning(f"snapshot {path} skipped: manifest unreadable "
+                        f"({e})")
+            continue
+        if man.get("format") != _FORMAT:
+            Log.warning(f"snapshot {path} skipped: unknown manifest "
+                        f"format {man.get('format')!r}")
+            continue
+        return it, path
+    return None
+
+
 def find_latest_snapshot(output_model: str, signature: str,
                          train_set) -> Optional[Tuple[int, str, np.ndarray]]:
     """Newest VALID snapshot as ``(iteration, model_path, score)``, or

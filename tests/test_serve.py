@@ -1,0 +1,752 @@
+"""Serving subsystem tests (lightgbm_tpu/serve/, docs/Serving.md).
+
+The acceptance bar of ISSUE 4: serve-path predictions — in-process AND
+over HTTP, including requests split across micro-batches — must be
+BYTE-IDENTICAL to ``Booster.predict`` across the objective/feature
+matrix (regression / binary / multiclass, categorical features,
+EFB-bundled models), and the bucketed compile cache must bound XLA
+compiles to ``ceil(log2(serve_max_batch)) + 1`` per model across 100
+mixed-size request batches.  Satellites: plain ``Booster.predict``
+through the same cache (compile counts recorded before/after),
+zero-row predict, backpressure semantics, hot reload.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.predict_device import forest_trace_count
+from lightgbm_tpu.serve import (BacklogFull, MicroBatcher, ModelRegistry,
+                                NoModelError, PredictorEngine, Server,
+                                start_http)
+from lightgbm_tpu.serve.batcher import BatcherClosed
+
+
+def _data(n=700, f=6, seed=0, nan_frac=0.08, cat_col=None):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, f)
+    if cat_col is not None:
+        x[:, cat_col] = rs.randint(0, 10, n)
+    x[rs.rand(n, f) < nan_frac] = np.nan
+    if cat_col is not None:
+        c = x[:, cat_col]
+        x[:, cat_col] = np.where(np.isnan(c), np.nan, np.abs(c))
+    return x
+
+
+def _train(params, x, y, rounds=10, **kw):
+    ds = lgb.Dataset(x, label=y, **kw)
+    return lgb.train({"verbosity": -1, "num_leaves": 8, **params}, ds,
+                     num_boost_round=rounds)
+
+
+def _legacy_predict(bst, x, **kw):
+    """Reference result: the pre-engine host-tree walk."""
+    old = bst.config.predict_bucketed
+    bst.config.predict_bucketed = False
+    try:
+        return bst.predict(x, **kw)
+    finally:
+        bst.config.predict_bucketed = old
+        bst._drop_predict_cache()
+
+
+def _model_matrix():
+    """(tag, booster, test-row factory) across the parity matrix."""
+    rs = np.random.RandomState(7)
+    out = []
+
+    x = _data(seed=1)
+    y = np.where(np.isnan(x[:, 0]), 0.3, x[:, 0] + 0.5 * x[:, 1])
+    out.append(("regression", _train({"objective": "regression"}, x, y),
+                lambda n: _data(n, seed=11)))
+
+    x = _data(seed=2)
+    y = (np.nan_to_num(x[:, 0]) > 0).astype(np.float64)
+    out.append(("binary", _train({"objective": "binary"}, x, y),
+                lambda n: _data(n, seed=12)))
+
+    x = _data(seed=3)
+    y = rs.randint(0, 3, len(x)).astype(np.float64)
+    out.append(("multiclass",
+                _train({"objective": "multiclass", "num_class": 3}, x, y),
+                lambda n: _data(n, seed=13)))
+
+    x = _data(seed=4, cat_col=2)
+    y = (np.nan_to_num(x[:, 2]) % 3 == 0).astype(np.float64)
+    out.append(("categorical",
+                _train({"objective": "binary"}, x, y,
+                       categorical_feature=[2]),
+                # unseen / negative / NaN categories included
+                lambda n: np.column_stack([
+                    _data(n, 5, seed=14),
+                    rs.randint(-2, 15, n).astype(np.float64)])[
+                        :, [0, 1, 5, 2, 3, 4]]))
+
+    # EFB-bundled model: dense block + mutually-exclusive one-hot block
+    n, n_cats = 900, 12
+    dense = rs.randn(n, 3)
+    cat = rs.randint(0, n_cats, n)
+    onehot = np.zeros((n, n_cats))
+    onehot[np.arange(n), cat] = 1.0
+    x = np.column_stack([dense, onehot])
+    y = (dense[:, 0] + (cat % 3 == 0) > 0.5).astype(np.float64)
+    bst = _train({"objective": "binary"}, x, y)
+    assert bst._model.train_set.efb is not None, "EFB did not trigger"
+
+    def _efb_rows(nn, rs=np.random.RandomState(15), n_cats=n_cats):
+        d = rs.randn(nn, 3)
+        c = rs.randint(0, n_cats, nn)
+        oh = np.zeros((nn, n_cats))
+        oh[np.arange(nn), c] = 1.0
+        return np.column_stack([d, oh])
+
+    out.append(("efb", bst, _efb_rows))
+    return out
+
+
+@pytest.fixture(scope="module")
+def model_matrix():
+    return _model_matrix()
+
+
+# ---------------------------------------------------------------------------
+# serve-path parity (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestServeParity:
+    def test_server_byte_identical_across_matrix(self, model_matrix):
+        """In-process serve == Booster.predict == legacy host walk,
+        byte for byte, with requests split across micro-batches."""
+        for tag, bst, rows_of in model_matrix:
+            xt = rows_of(157)
+            ref = _legacy_predict(bst, xt)
+            direct = bst.predict(xt)
+            assert np.array_equal(ref, direct), tag
+            assert ref.dtype == direct.dtype, tag
+            srv = Server({"serve_max_batch": 32, "serve_max_wait_ms": 20.0},
+                         booster=bst)
+            try:
+                # uneven request sizes force coalescing AND splitting
+                # across several micro-batches (32-row cap, 157 rows)
+                futs = [srv.submit(xt[i:i + 13])
+                        for i in range(0, len(xt), 13)]
+                got = np.concatenate([f.result(30) for f in futs])
+            finally:
+                srv.close()
+            assert np.array_equal(ref, got), tag
+            assert ref.dtype == got.dtype, tag
+            assert futs[0].info["model_version"] == "v1"
+
+    def test_http_byte_identical(self, model_matrix):
+        for tag, bst, rows_of in model_matrix:
+            xt = rows_of(41)
+            ref = np.asarray(bst.predict(xt))
+            srv = Server({"serve_max_batch": 16, "serve_max_wait_ms": 1.0},
+                         booster=bst)
+            fe = start_http(srv, port=0)
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{fe.port}/predict",
+                    data=json.dumps({"rows": xt.tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = json.loads(urllib.request.urlopen(req).read())
+            finally:
+                fe.close()
+                srv.close()
+            got = np.asarray(resp["predictions"], ref.dtype)
+            # JSON floats round-trip f32/f64 exactly (repr round trip)
+            assert np.array_equal(ref, got), tag
+            assert resp["model_version"] == "v1"
+            assert resp["num_rows"] == len(xt)
+
+    def test_iteration_slicing_parity(self):
+        x = _data(400, seed=16)
+        y = np.nan_to_num(x[:, 0])
+        bst = _train({"objective": "regression",
+                      "predict_bucketed": True}, x, y, rounds=12)
+        xt = _data(30, seed=17)
+        for kw in ({"start_iteration": 3}, {"num_iteration": 5},
+                   {"start_iteration": 2, "num_iteration": 4},
+                   {"raw_score": True, "num_iteration": 0}):
+            got, ref = bst.predict(xt, **kw), _legacy_predict(bst, xt, **kw)
+            assert np.array_equal(got, ref), kw
+        lref = _legacy_predict(bst, xt, pred_leaf=True, start_iteration=4)
+        assert np.array_equal(
+            bst.predict(xt, pred_leaf=True, start_iteration=4), lref)
+
+    def test_engine_predict_matches_booster(self, model_matrix):
+        for tag, bst, rows_of in model_matrix:
+            xt = rows_of(33)
+            eng = PredictorEngine.from_booster(bst)
+            assert np.array_equal(eng.predict(xt), bst.predict(xt)), tag
+            assert np.array_equal(eng.predict(xt, raw_score=True),
+                                  bst.predict(xt, raw_score=True)), tag
+
+
+# ---------------------------------------------------------------------------
+# bucketed compile cache (acceptance criterion + satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestCompileCache:
+    def test_bounded_compiles_100_mixed_batches(self):
+        x = _data(500, seed=21)
+        y = np.nan_to_num(x[:, 0])
+        # distinctive (T, M) SoA shape: the trace counter is process-
+        # wide and the shared jit would (correctly) give 0 traces for a
+        # shape another test already compiled
+        bst = _train({"objective": "regression", "num_leaves": 16},
+                     x, y, rounds=14)
+        max_batch = 1024
+        eng = PredictorEngine.from_booster(bst, max_batch=max_batch)
+        rs = np.random.RandomState(0)
+        sizes = rs.randint(1, max_batch + 1, 100)
+        before = forest_trace_count()
+        for n in sizes:
+            eng.leaf_ids(_data(int(n), seed=int(n)))
+        traces = forest_trace_count() - before
+        bound = int(np.ceil(np.log2(max_batch))) + 1
+        assert traces <= bound, (traces, bound)
+        stats = eng.compile_stats()
+        assert len(stats["buckets"]) <= bound
+        assert all(b & (b - 1) == 0 for b in stats["buckets"]), \
+            "buckets must be powers of two"
+        assert stats["max_compiles_bound"] == bound
+
+    def test_booster_predict_stops_retracing(self):
+        """Satellite 1: plain Booster.predict rides the same bucketed
+        cache — compile counts recorded before/after show that varying
+        row counts stop re-tracing once their buckets are warm."""
+        x = _data(400, seed=22)
+        y = np.nan_to_num(x[:, 1])
+        bst = _train({"objective": "regression", "num_leaves": 12,
+                      "predict_bucketed": True},
+                     x, y, rounds=9)          # unique (T, M) shape
+        warm = forest_trace_count()
+        for n in (5, 100, 300):              # warm buckets 16, 128, 512
+            bst.predict(_data(n, seed=n))
+        warmed = forest_trace_count() - warm
+        assert 1 <= warmed <= 3
+        before = forest_trace_count()
+        for n in (3, 7, 11, 16, 70, 90, 128, 257, 300, 400, 511, 512):
+            bst.predict(_data(n, seed=n))    # all within warm buckets
+        assert forest_trace_count() == before, \
+            "varying row counts must not re-trace inside warm buckets"
+
+    def test_min_bucket_floors_tiny_batches(self):
+        x = _data(200, seed=23)
+        bst = _train({"objective": "regression"}, x,
+                     np.nan_to_num(x[:, 0]))
+        eng = PredictorEngine.from_booster(bst, min_bucket=16)
+        for n in (1, 2, 3, 7, 15, 16):
+            eng.leaf_ids(_data(n, seed=n))
+        assert list(eng.compile_stats()["buckets"]) == [16]
+
+    def test_predict_bucketed_false_uses_host_path(self):
+        x = _data(100, seed=24)
+        bst = _train({"objective": "regression", "predict_bucketed":
+                      False}, x, np.nan_to_num(x[:, 0]))
+        assert bst.predict_engine() is None
+        before = forest_trace_count()
+        bst.predict(_data(10, seed=1))
+        assert forest_trace_count() == before
+
+    def test_auto_mode_engages_on_large_workloads(self):
+        """predict_bucketed=auto (the default): small predicts stay on
+        the host walk; once rows x trees clears the threshold the
+        engine builds and serves every later call — byte-identically."""
+        x = _data(600, seed=28)
+        bst = _train({"objective": "regression"}, x,
+                     np.nan_to_num(x[:, 0]), rounds=40)
+        assert bst.config.predict_bucketed == "auto"
+        assert bst.predict_engine(10) is None
+        assert bst._engine_cache is None
+        xt = _data(2000, seed=29)
+        ref = _legacy_predict(bst, xt)
+        got = bst.predict(xt)          # 2000 x 40 trees: engine engages
+        assert bst._engine_cache not in (None, False)
+        assert np.array_equal(ref, got)
+        assert bst.predict_engine(1) is not None   # built: serves all
+        small = _data(4, seed=30)
+        got_small = bst.predict(small)             # rides the engine
+        assert np.array_equal(got_small, _legacy_predict(bst, small))
+
+    def test_engine_cache_invalidated_by_training(self):
+        x = _data(300, seed=25)
+        ds = lgb.Dataset(x, label=np.nan_to_num(x[:, 0]))
+        bst = lgb.Booster(params={"objective": "regression",
+                                  "predict_bucketed": True,
+                                  "verbosity": -1}, train_set=ds)
+        bst.update()
+        e1 = bst.predict_engine()
+        assert e1 is not None and len(e1.trees) == 1
+        bst.update()
+        e2 = bst.predict_engine()
+        assert e2 is not e1 and len(e2.trees) == 2
+
+
+# ---------------------------------------------------------------------------
+# zero-row predict (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestZeroRow:
+    def test_zero_rows_empty_result_no_device(self, model_matrix):
+        for tag, bst, rows_of in model_matrix:
+            k = bst._num_tree_per_iteration
+            f = bst.num_feature()
+            before = forest_trace_count()
+            out = bst.predict(np.empty((0, f)))
+            assert forest_trace_count() == before, tag
+            ref = bst.predict(rows_of(3))
+            assert out.shape == ((0,) if k == 1 else (0, k)), tag
+            assert out.dtype == ref.dtype, tag
+            leaf = bst.predict(np.empty((0, f)), pred_leaf=True)
+            assert leaf.shape == (0, len(bst.trees))
+            assert leaf.dtype == np.int32
+            raw = bst.predict(np.empty((0, f)), raw_score=True)
+            assert raw.dtype == np.float64
+
+    def test_zero_rows_shape_check_still_applies(self):
+        x = _data(100, seed=26)
+        bst = _train({"objective": "regression"}, x,
+                     np.nan_to_num(x[:, 0]))
+        from lightgbm_tpu.basic import LightGBMError
+        with pytest.raises(LightGBMError, match="predict_disable_shape_check"):
+            bst.predict(np.empty((0, 3)))
+        out = bst.predict(np.empty((0, 3)),
+                          predict_disable_shape_check=True)
+        assert out.shape == (0,)
+
+    def test_zero_rows_through_server(self):
+        x = _data(100, seed=27)
+        bst = _train({"objective": "binary"}, x,
+                     (np.nan_to_num(x[:, 0]) > 0).astype(float))
+        srv = Server({}, booster=bst)
+        try:
+            out = srv.predict(np.empty((0, x.shape[1])))
+        finally:
+            srv.close()
+        assert out.shape == (0,)
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+class TestBatcher:
+    def test_coalesces_concurrent_requests(self):
+        seen = []
+
+        def predict_fn(rows):
+            seen.append(len(rows))
+            return rows[:, 0] * 2.0
+
+        gate = MicroBatcher(predict_fn, max_batch=64, max_wait_ms=150.0,
+                            queue_rows=1024)
+        try:
+            futs = [gate.submit(np.full((5, 2), i, float))
+                    for i in range(6)]
+            outs = [f.result(10) for f in futs]
+        finally:
+            gate.close()
+        for i, o in enumerate(outs):
+            assert np.array_equal(o, np.full(5, 2.0 * i))
+        # the 60 ms window coalesced (sub-ms submits) into ONE batch
+        assert max(seen) == 30
+
+    def test_backpressure_rejects_with_retry_after(self):
+        release = threading.Event()
+
+        def predict_fn(rows):
+            release.wait(10)
+            return rows[:, 0]
+
+        gate = MicroBatcher(predict_fn, max_batch=4, max_wait_ms=0.0,
+                            queue_rows=8)
+        try:
+            futs = [gate.submit(np.zeros((4, 1)))]
+            time.sleep(0.05)            # worker picks up batch 1, blocks
+            futs += [gate.submit(np.zeros((4, 1))),
+                     gate.submit(np.zeros((4, 1)))]
+            with pytest.raises(BacklogFull) as ei:
+                gate.submit(np.zeros((4, 1)))
+            assert ei.value.retry_after_ms > 0
+            assert ei.value.depth_rows == 8
+            release.set()
+            for f in futs:
+                f.result(10)
+        finally:
+            release.set()
+            gate.close()
+
+    def test_transient_errors_retry_fatal_do_not(self):
+        from lightgbm_tpu.utils.resilience import RetryPolicy
+        calls = {"n": 0}
+
+        def flaky(rows):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("collective timed out")  # transient
+            return rows[:, 0]
+
+        gate = MicroBatcher(flaky, max_batch=8, max_wait_ms=0.0,
+                            retry_policy=RetryPolicy(max_attempts=2,
+                                                     base_delay_s=0.01))
+        try:
+            assert gate.submit(np.ones((2, 1))).result(10) is not None
+            assert calls["n"] == 2
+
+            def fatal(rows):
+                raise TypeError("broken request")
+
+            gate.predict_fn = fatal
+            with pytest.raises(TypeError):
+                gate.submit(np.ones((2, 1))).result(10)
+        finally:
+            gate.close()
+
+    def test_close_drains_queue_then_rejects_new(self):
+        hold = threading.Event()
+        gate = MicroBatcher(lambda r: (hold.wait(5), r[:, 0])[1],
+                            max_batch=2, max_wait_ms=0.0)
+        f1 = gate.submit(np.zeros((2, 1)))
+        time.sleep(0.05)
+        f2 = gate.submit(np.zeros((2, 1)))   # queued behind the block
+        hold.set()
+        gate.close()
+        f1.result(5)                 # in-flight batch completed
+        f2.result(5)                 # queued work drained before exit
+        with pytest.raises(BatcherClosed):
+            gate.submit(np.zeros((1, 1)))
+
+    def test_mixed_width_requests_never_kill_worker(self):
+        """A wrong-width request must fail ALONE: widths never
+        concatenate into one batch, and no request failure may kill the
+        worker thread (which would hang every later request)."""
+        gate = MicroBatcher(lambda r: r[:, 0], max_batch=64,
+                            max_wait_ms=50.0)
+        try:
+            f_a = gate.submit(np.zeros((3, 2)))
+            f_b = gate.submit(np.zeros((3, 5)))   # width change: own batch
+            assert np.array_equal(f_a.result(10), np.zeros(3))
+            assert np.array_equal(f_b.result(10), np.zeros(3))
+            assert gate._worker.is_alive()
+            # 1-D vector = one row; >2-D rejected at submit, reaching
+            # only the offending caller
+            assert gate.submit(np.zeros(4)).result(10).shape == (1,)
+            with pytest.raises(ValueError, match="2-D"):
+                gate.submit(np.zeros((1, 2, 2)))
+            # a predict_fn that raises fails its batch, not the worker
+            def boom(rows):
+                raise RuntimeError("boom")
+            gate.predict_fn = boom
+            with pytest.raises(RuntimeError):
+                gate.submit(np.zeros((1, 2))).result(10)
+            assert gate._worker.is_alive()
+            gate.predict_fn = lambda r: r[:, 0]
+            assert gate.submit(np.zeros((2, 2))).result(10).shape == (2,)
+        finally:
+            gate.close()
+
+    def test_metrics_recorded(self):
+        from lightgbm_tpu.obs import MetricsRegistry
+        m = MetricsRegistry()
+        gate = MicroBatcher(lambda r: r[:, 0], max_batch=8,
+                            max_wait_ms=0.0, metrics=m)
+        try:
+            gate.submit(np.zeros((3, 1))).result(10)
+        finally:
+            gate.close()
+        snap = m.snapshot()
+        assert snap["serve.requests"]["value"] == 1
+        assert snap["serve.rows"]["value"] == 3
+        assert snap["serve.batch_rows"]["count"] == 1
+        assert snap["serve.latency"]["count"] == 1
+        occ = snap["serve.batch_occupancy"]
+        assert 0 < occ["max"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# registry / hot reload
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def _boosters(self):
+        x = _data(300, seed=30)
+        y = np.nan_to_num(x[:, 0])
+        b1 = _train({"objective": "regression"}, x, y, rounds=5)
+        b2 = _train({"objective": "regression", "learning_rate": 0.3},
+                    x, y, rounds=9)
+        return b1, b2
+
+    def test_atomic_swap_old_handle_survives(self):
+        b1, b2 = self._boosters()
+        reg = ModelRegistry()
+        v1 = reg.load(booster=b1)
+        old = reg.current()
+        v2 = reg.load(model_str=b2.model_to_string())
+        assert (v1, v2) == ("v1", "v2")
+        assert reg.current().version == "v2"
+        # the handle resolved BEFORE the swap still serves the old model
+        xt = _data(20, seed=31)
+        assert np.array_equal(old.booster.predict(xt), b1.predict(xt))
+        assert len(reg.current().booster.trees) == 9
+
+    def test_unload_guards_current(self):
+        b1, b2 = self._boosters()
+        reg = ModelRegistry()
+        reg.load(booster=b1)
+        reg.load(booster=b2)
+        with pytest.raises(ValueError, match="current"):
+            reg.unload("v2")
+        reg.activate("v1")
+        reg.unload("v2")
+        assert [v["version"] for v in reg.versions()] == ["v1"]
+        with pytest.raises(KeyError):
+            reg.get("v2")
+
+    def test_no_model_error(self):
+        with pytest.raises(NoModelError):
+            ModelRegistry().current()
+
+    def test_load_snapshot_complete_only(self, tmp_path):
+        x = _data(300, seed=32)
+        y = np.nan_to_num(x[:, 0])
+        out = str(tmp_path / "model.txt")
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "output_model": out, "snapshot_freq": 2,
+                         "snapshot_keep": 0},
+                        lgb.Dataset(x, label=y), num_boost_round=6)
+        # newest snapshot made incomplete: manifest missing == the
+        # mid-write crash window; the registry must fall back
+        import glob as _glob
+        snaps = sorted(_glob.glob(out + ".snapshot_iter_*"))
+        snaps = [s for s in snaps if s.endswith("6")]
+        assert snaps
+        import os
+        os.unlink(snaps[0] + ".manifest.json")
+        reg = ModelRegistry()
+        v = reg.load_snapshot(out)
+        assert "snapshot iter 4" in reg.get(v).source
+        xt = _data(10, seed=33)
+        assert np.array_equal(
+            reg.get(v).booster.predict(xt),
+            bst.predict(xt, num_iteration=4))
+
+    def test_server_reload_switches_new_requests(self):
+        b1, b2 = self._boosters()
+        srv = Server({"serve_max_wait_ms": 0.0}, booster=b1)
+        try:
+            xt = _data(15, seed=34)
+            f1 = srv.submit(xt)
+            assert np.array_equal(f1.result(10), b1.predict(xt))
+            assert f1.info["model_version"] == "v1"
+            v2 = srv.reload(booster=b2)
+            f2 = srv.submit(xt)
+            assert np.array_equal(f2.result(10), b2.predict(xt))
+            assert f2.info["model_version"] == v2 == "v2"
+            assert srv.health()["model"]["version"] == "v2"
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend
+# ---------------------------------------------------------------------------
+
+class TestHttp:
+    @pytest.fixture()
+    def served(self):
+        x = _data(300, seed=40)
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(float)
+        bst = _train({"objective": "binary"}, x, y)
+        srv = Server({"serve_max_wait_ms": 1.0}, booster=bst)
+        fe = start_http(srv, port=0)
+        yield bst, srv, f"http://127.0.0.1:{fe.port}"
+        fe.close()
+        srv.close()
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req).read())
+
+    def test_healthz_and_metrics(self, served):
+        bst, srv, base = served
+        self._post(base + "/predict", {"rows": _data(8, seed=41).tolist()})
+        h = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert h["status"] == "ok"
+        assert h["model"]["num_trees"] == len(bst.trees)
+        assert h["versions"][0]["current"] is True
+        m = json.loads(urllib.request.urlopen(base + "/metrics").read())
+        assert m["serve.requests"]["value"] >= 1
+        assert m["serve.latency_quantiles"]["p99_s"] > 0
+        eng = m["serve.engine"]
+        assert eng["buckets"] and eng["max_compiles_bound"] >= 1
+
+    def test_bad_requests(self, served):
+        _, _, base = served
+        for payload, frag in [({}, "missing 'rows'"),
+                              ({"rows": [[[1]]]}, "bad rows")]:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(base + "/predict", payload)
+            assert ei.value.code == 400
+            assert frag in json.loads(ei.value.read())["error"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope")
+        assert ei.value.code == 404
+        # wrong feature count: the model's shape check fails THIS
+        # request as a 400 (never 500, never another request's batch)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base + "/predict", {"rows": [[1.0, 2.0]]})
+        assert ei.value.code == 400
+        assert "predict_disable_shape_check" in \
+            json.loads(ei.value.read())["error"]
+        # ...and the server still answers afterwards
+        ok = self._post(base + "/predict",
+                        {"rows": _data(2, seed=46).tolist()})
+        assert ok["num_rows"] == 2
+
+    def test_http_429_backpressure(self):
+        x = _data(200, seed=42)
+        bst = _train({"objective": "regression"}, x,
+                     np.nan_to_num(x[:, 0]))
+        srv = Server({"serve_max_batch": 4, "serve_max_wait_ms": 0.0,
+                      "serve_queue_rows": 8}, booster=bst)
+        # wedge the worker so the bounded queue fills
+        hold = threading.Event()
+        real = srv._predict_batch
+
+        def slow(rows):
+            hold.wait(10)
+            return real(rows)
+
+        srv.batcher.predict_fn = slow
+        fe = start_http(srv, port=0)
+        base = f"http://127.0.0.1:{fe.port}"
+        try:
+            rows = _data(4, seed=43).tolist()
+            futs = [srv.submit(np.asarray(rows))]
+            time.sleep(0.1)          # worker picks batch 1 and wedges
+            futs += [srv.submit(np.asarray(rows)) for _ in range(2)]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(base + "/predict", {"rows": rows})
+            assert ei.value.code == 429
+            assert ei.value.headers["Retry-After"]
+            assert json.loads(ei.value.read())["retry_after_ms"] > 0
+        finally:
+            hold.set()
+            for f in futs:
+                f.result(10)
+            fe.close()
+            srv.close()
+
+    def test_http_reload(self, served, tmp_path):
+        bst, srv, base = served
+        x = _data(300, seed=44)
+        y = (np.nan_to_num(x[:, 1]) > 0).astype(float)
+        b2 = _train({"objective": "binary", "learning_rate": 0.2}, x, y)
+        path = str(tmp_path / "m2.txt")
+        b2.save_model(path)
+        resp = self._post(base + "/reload", {"model_file": path})
+        assert resp["model_version"] == "v2"
+        xt = _data(9, seed=45)
+        got = self._post(base + "/predict", {"rows": xt.tolist()})
+        assert got["model_version"] == "v2"
+        assert np.array_equal(
+            np.asarray(got["predictions"], np.float32),
+            np.asarray(b2.predict(xt), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# CLI + config surface
+# ---------------------------------------------------------------------------
+
+class TestCliAndConfig:
+    def test_bare_serve_token_maps_to_task(self):
+        from lightgbm_tpu.cli import _load_params
+        p = _load_params(["serve", "input_model=m.txt",
+                          "serve_port=1234"])
+        assert p["task"] == "serve"
+        assert p["input_model"] == "m.txt"
+        assert p["serve_port"] == "1234"
+
+    def test_serve_params_accepted_and_clamped(self):
+        from lightgbm_tpu.config import Config
+        cfg = Config({"serve_max_batch": 64, "serve_min_bucket": 256,
+                      "serve_queue_rows": 1})
+        assert cfg.serve_min_bucket == 64     # clamped to the batch cap
+        assert cfg.serve_queue_rows == 64     # holds >= one full batch
+        with pytest.raises(ValueError):
+            Config({"serve_max_batch": 0})
+        with pytest.raises(ValueError):
+            Config({"serve_max_wait_ms": -1})
+        assert Config({}).predict_bucketed == "auto"
+        assert Config({"predict_bucketed": True}).predict_bucketed \
+            == "true"
+        with pytest.raises(ValueError):
+            Config({"predict_bucketed": "sometimes"})
+
+    def test_histogram_quantile(self):
+        from lightgbm_tpu.obs.metrics import Histogram
+        h = Histogram(buckets=(1.0, 2.0, 4.0))
+        assert h.quantile(0.5) is None
+        for v in (0.5, 1.5, 1.5, 3.0, 8.0):
+            h.observe(v)
+        q50 = h.quantile(0.5)
+        assert 1.0 <= q50 <= 2.0
+        assert h.quantile(0.0) <= h.quantile(0.5) <= h.quantile(1.0)
+        assert h.quantile(1.0) == 8.0
+
+    def test_engine_unsupported_falls_back(self):
+        """A hand-built model mixing NaN-routing and NaN-converting
+        nodes on one feature is refused by the engine; Booster.predict
+        silently falls back to the host walk."""
+        x = _data(200, seed=50, nan_frac=0.3)
+        y = np.nan_to_num(x[:, 0])
+        b1 = _train({"objective": "regression"}, x, y, rounds=3)
+        x2 = _data(200, seed=51, nan_frac=0.0)
+        b2 = _train({"objective": "regression"}, x2,
+                    x2[:, 0], rounds=3)
+        from lightgbm_tpu.serve.engine import EngineUnsupported
+        b2._merge_from(b1)
+        feats = {int(f) for t in b1.trees for f in t.split_feature}
+        feats &= {int(f) for t in b2.trees[len(b1.trees):]
+                  for f in t.split_feature}
+        if not feats:
+            pytest.skip("no shared split feature between the two models")
+        miss = set()
+        for t in b2.trees:
+            for i in range(t.num_nodes()):
+                if int(t.split_feature[i]) in feats:
+                    miss.add((int(t.decision_type[i]) >> 2) & 3)
+        if not (2 in miss and (miss - {2})):
+            pytest.skip("merge did not produce mixed missing types")
+        b2.config.predict_bucketed = "true"
+        assert b2.predict_engine() is None
+        with pytest.raises(EngineUnsupported):
+            PredictorEngine.from_booster(b2)
+        xt = _data(10, seed=52)
+        assert np.array_equal(b2.predict(xt),
+                              _legacy_predict(b2, xt))
+
+    def test_device_binning_mode_close_but_opt_in(self):
+        """serve_device_binning: on-device f32 binning is approximate on
+        threshold ties — results must still agree on clearly-separated
+        values."""
+        rs = np.random.RandomState(60)
+        x = rs.randint(0, 20, (400, 4)).astype(np.float64)
+        y = (x[:, 0] > 10).astype(np.float64)
+        bst = _train({"objective": "binary"}, x, y)
+        eng = PredictorEngine.from_booster(bst)
+        xt = rs.randint(0, 20, (50, 4)).astype(np.float64) + 0.25
+        exact = eng.predict(xt)
+        approx = eng.predict(xt, device_binning=True)
+        assert np.array_equal(exact, approx)

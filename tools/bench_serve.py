@@ -1,0 +1,147 @@
+"""Serving throughput/latency microbench (rows/s, p50/p99 ms).
+
+Trains a small model, stands up the in-process serve stack
+(``serve.Server``: micro-batcher + bucketed predictor engine) and
+hammers it from concurrent client threads for a fixed duration,
+measuring client-observed request latency.  The numbers fold into
+``bench.py`` extras as ``serve_rows_per_s`` / ``serve_p99_ms``
+(docs/Serving.md records the capture discipline).
+
+Run standalone::
+
+    python tools/bench_serve.py [key=value ...]
+      duration_s=3 clients=4 rows_per_request=64 serve_max_batch=1024
+      http=0 n_train=20000 n_feat=28
+
+Prints one JSON line with the measured point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def build_model(n_train: int = 20000, n_feat: int = 28, seed: int = 0,
+                num_leaves: int = 31, rounds: int = 50):
+    """HIGGS-shaped binary model (bench.py's data family)."""
+    import lightgbm_tpu as lgb
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n_train, n_feat).astype(np.float32)
+    y = ((1.2 * x[:, 0] - 0.8 * x[:, 1] + 0.6 * x[:, 2] * x[:, 3]
+          + 0.5 * rs.randn(n_train)) > 0).astype(np.float32)
+    ds = lgb.Dataset(x, label=y)
+    return lgb.train({"objective": "binary", "num_leaves": num_leaves,
+                      "verbosity": -1}, ds, num_boost_round=rounds)
+
+
+def run_bench(booster=None, duration_s: float = 3.0, clients: int = 4,
+              rows_per_request: int = 64, http: bool = False,
+              params: dict | None = None, n_train: int = 20000,
+              n_feat: int = 28) -> dict:
+    """Drive the serve stack; returns the measured point as a dict."""
+    from lightgbm_tpu.serve import Server, start_http
+    if booster is None:
+        booster = build_model(n_train=n_train, n_feat=n_feat)
+    nf = booster.num_feature()
+    srv = Server(dict(params or {}), booster=booster)
+    fe = start_http(srv, port=0) if http else None
+    rs = np.random.RandomState(1)
+    pool = rs.randn(4096, nf)
+
+    lat: list = []
+    rows_done = [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def _client(cid: int):
+        local_lat, local_rows = [], 0
+        url = (f"http://127.0.0.1:{fe.port}/predict" if http else None)
+        while not stop.is_set():
+            lo = (cid * 131 + len(local_lat) * rows_per_request) % \
+                (len(pool) - rows_per_request)
+            rows = pool[lo:lo + rows_per_request]
+            t0 = time.perf_counter()
+            if http:
+                import urllib.request
+                req = urllib.request.Request(
+                    url, data=json.dumps({"rows": rows.tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                json.loads(urllib.request.urlopen(req).read())
+            else:
+                srv.predict(rows, timeout=30)
+            local_lat.append(time.perf_counter() - t0)
+            local_rows += len(rows)
+        with lock:
+            lat.extend(local_lat)
+            rows_done[0] += local_rows
+
+    # warmup outside the window: every bucket the measured window can
+    # hit compiles here (single requests, one request's rows, and the
+    # largest coalesced batch the client pool can form)
+    srv.predict(pool[:1])
+    srv.predict(pool[:rows_per_request])
+    srv.predict(pool[:min(len(pool), clients * rows_per_request)])
+
+    threads = [threading.Thread(target=_client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    wall = time.perf_counter() - t0
+    snap = srv.metrics_snapshot()
+    eng = snap.get("serve.engine", {})
+    occ = snap.get("serve.batch_occupancy", {})
+    if fe is not None:
+        fe.close()
+    srv.close()
+
+    lat_ms = np.asarray(lat) * 1e3
+    point = {
+        "rows_per_s": round(rows_done[0] / max(wall, 1e-9), 1),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3)
+        if len(lat_ms) else None,
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3)
+        if len(lat_ms) else None,
+        "requests": int(len(lat_ms)),
+        "clients": clients,
+        "rows_per_request": rows_per_request,
+        "http": bool(http),
+        "batch_occupancy_mean": round(occ["sum"] / occ["count"], 4)
+        if occ.get("count") else None,
+        "engine_buckets": sorted(int(b) for b in eng.get("buckets", {})),
+        "compile_bound": eng.get("max_compiles_bound"),
+    }
+    return point
+
+
+def main() -> int:
+    kv = dict(tok.split("=", 1) for tok in sys.argv[1:] if "=" in tok)
+    serve_params = {k: v for k, v in kv.items()
+                    if k.startswith("serve_")}
+    point = run_bench(
+        duration_s=float(kv.get("duration_s", 3.0)),
+        clients=int(kv.get("clients", 4)),
+        rows_per_request=int(kv.get("rows_per_request", 64)),
+        http=kv.get("http", "0") not in ("0", "false", ""),
+        params=serve_params,
+        n_train=int(kv.get("n_train", 20000)),
+        n_feat=int(kv.get("n_feat", 28)))
+    print(json.dumps({"metric": "serve_rows_per_s", **point}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
