@@ -109,7 +109,10 @@ class Booster:
         self._engine_cache = None
 
         if model_file is not None:
-            with open(model_file) as f:
+            # utf-8 to match the write side (atomic_write / snapshot
+            # checksums hash utf-8 bytes); the locale default would
+            # desynchronize read and write on non-utf-8 hosts
+            with open(model_file, encoding="utf-8") as f:
                 self._load_model_string(f.read())
             return
         if model_str is not None:

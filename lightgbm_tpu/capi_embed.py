@@ -142,7 +142,10 @@ def booster_save_model_to_string(bst: Booster, start_iteration: int,
 
 def booster_save_model(bst: Booster, start_iteration: int,
                        num_iteration: int, filename: str) -> None:
-    with open(filename, "w") as f:
+    # utf-8 to match Booster's load side and the artifact-checksum
+    # convention (snapshot manifests hash utf-8 bytes); the locale
+    # default would break the round-trip on non-utf-8 hosts
+    with open(filename, "w", encoding="utf-8") as f:
         f.write(booster_save_model_to_string(bst, start_iteration,
                                              num_iteration))
 

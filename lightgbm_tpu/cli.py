@@ -118,24 +118,59 @@ def _task_serve(cfg: Config, params: Dict) -> int:
     HTTP prediction service (docs/Serving.md).  The model comes from
     ``input_model``, or — with ``resume=true`` — from the newest
     complete snapshot of ``output_model`` (hot-reloadable at runtime
-    via ``POST /reload``)."""
+    via ``POST /reload``).
+
+    Shutdown is GRACEFUL: SIGTERM (the orchestrator's stop signal) and
+    SIGINT first drain — new requests are refused with 503, queued
+    work finishes within ``serve_drain_s`` — then the frontend and
+    server close.  A second signal during the drain skips straight to
+    exit."""
+    import os
+    import signal
+    import threading as _threading
+
     from .serve.server import Server, start_http
-    server = Server(params)
-    frontend = start_http(server, cfg.serve_host, cfg.serve_port,
-                          background=False)
-    health = server.health()
-    model = health.get("model") or {}
-    print(f"serving {model.get('source', '<none>')} "
-          f"(version {model.get('version')}) on "
-          f"http://{cfg.serve_host}:{frontend.port} — "
-          f"/predict /healthz /metrics /reload", flush=True)
+    stop = _threading.Event()
+
+    def _on_signal(signum, _frame):
+        if stop.is_set():
+            # second signal: the operator wants OUT NOW.  os._exit, not
+            # SystemExit — an exception would still unwind through the
+            # finally below, whose frontend/server closes join the very
+            # worker the drain is already stuck on (up to ~5s), and the
+            # orchestrator's kill grace would SIGKILL us mid-close
+            print(f"serve: second signal {signum}; exiting immediately",
+                  flush=True)
+            os._exit(128 + signum)
+        print(f"serve: received signal {signum}; draining "
+              f"(budget {cfg.serve_drain_s:g}s)", flush=True)
+        stop.set()
+
+    # handlers BEFORE bring-up: a stop signal racing the announcement
+    # (or arriving mid-bring-up) must drain, not kill the process
+    previous = {s: signal.signal(s, _on_signal)
+                for s in (signal.SIGTERM, signal.SIGINT)}
     try:
-        frontend.serve_forever()
-    except KeyboardInterrupt:
-        pass
+        server = Server(params)
+        frontend = start_http(server, cfg.serve_host, cfg.serve_port,
+                              background=True)
+        health = server.health()
+        model = health.get("model") or {}
+        print(f"serving {model.get('source', '<none>')} "
+              f"(version {model.get('version')}) on "
+              f"http://{cfg.serve_host}:{frontend.port} — "
+              f"/predict /healthz /metrics /reload /drain", flush=True)
+        stop.wait()
+        report = server.drain()
+        print(f"serve: drain {'complete' if report['drained'] else 'TIMED OUT'}"
+              f" ({report['leftover_rows']} rows left)", flush=True)
     finally:
-        frontend.close()
-        server.close()
+        for s, h in previous.items():
+            signal.signal(s, h)
+        if "frontend" in locals():
+            frontend.close()
+        if "server" in locals():
+            server.close()
     return 0
 
 

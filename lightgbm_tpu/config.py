@@ -273,6 +273,29 @@ _PARAMS: Dict[str, tuple] = {
     "serve_device_binning": (bool, False, []),
     "serve_host": (str, "127.0.0.1", []),
     "serve_port": (int, 7070, []),
+    # default per-request deadline (ms): requests are failed-fast at
+    # admission when the queue's estimated wait already exceeds it, and
+    # shed before dispatch when it lapsed while queued — device time is
+    # never spent on a request the client has abandoned.  0 = none;
+    # per-request deadline_ms overrides
+    "serve_deadline_ms": (float, 0.0, ["serve_default_deadline_ms"]),
+    # consecutive FAILED batches (infrastructure errors, after
+    # serve_retries) that open the serving circuit breaker: while open,
+    # submissions are rejected up front (HTTP 503 + Retry-After)
+    # instead of queuing onto a failing device; after the cooldown a
+    # probe batch decides close vs re-open (cooldown doubles, capped at
+    # 16x).  0 disables the breaker
+    "serve_breaker_failures": (int, 5, []),
+    "serve_breaker_cooldown_ms": (float, 1000.0, []),
+    # graceful-drain budget (seconds) on shutdown (SIGTERM / POST
+    # /drain / Server.drain): new work is refused, queued work finishes
+    # within the budget, leftovers fail with BatcherClosed
+    "serve_drain_s": (float, 5.0, []),
+    # verify artifacts before activation: SHA-256 of model files
+    # against the snapshot manifest's recorded checksum, plus the
+    # engine's byte-parity self-check probe (fall back to the host walk
+    # on mismatch).  Disable only to shave load latency
+    "serve_verify_artifacts": (bool, True, []),
     # ---- IO / task ----
     "task": (str, "train", ["task_type"]),
     "data": (str, "", ["train", "train_data", "train_data_file", "data_filename"]),
@@ -538,6 +561,20 @@ class Config:
                                            self.serve_max_batch))
         self.serve_queue_rows = max(self.serve_queue_rows,
                                     self.serve_max_batch)
+        for knob in ("serve_deadline_ms", "serve_drain_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0")
+        if self.serve_breaker_cooldown_ms <= 0:
+            # 0 is not "retry immediately": a zero cooldown makes every
+            # caller the half-open probe, so an open circuit would
+            # never reject anything — the breaker would silently not
+            # exist (disable it via serve_breaker_failures=0 instead)
+            raise ValueError("serve_breaker_cooldown_ms must be > 0 "
+                             "(set serve_breaker_failures=0 to disable "
+                             "the breaker)")
+        if self.serve_breaker_failures < 0:
+            raise ValueError("serve_breaker_failures must be >= 0 "
+                             "(0 disables the breaker)")
         # verbosity drives the global log level with reference semantics
         # (config.h: <0 fatal-only, 0 warnings, 1 info, >=2 debug; the
         # reference's Config::Set calls Log::ResetLogLevel the same way)

@@ -17,23 +17,34 @@ layouts, arXiv:1706.08359 batched device traversal):
 - ``registry``  versioned model registry with atomic hot swap;
                 in-flight requests finish on the version they started
                 on.
+- ``breaker``   serving circuit breaker: admission-time rejection
+                (503 + Retry-After) while the device side is failing,
+                half-open probes with exponentially backed-off
+                cooldowns (``utils/resilience.CircuitBreaker``).
 - ``server``    in-process ``Server`` API + stdlib-only HTTP frontend
-                (``/predict``, ``/healthz``, ``/metrics``), wired into
-                the obs subsystem (``serve.*`` metrics, per-batch
-                spans).
+                (``/predict``, ``/healthz``, ``/metrics``, ``/drain``),
+                wired into the obs subsystem (``serve.*`` metrics,
+                per-batch spans).
 
-See docs/Serving.md.
+Hardening (deadlines, breaker, graceful drain, verified artifacts,
+chaos soak harness): docs/Serving.md "Hardening" and
+tools/soak_serve.py.
 """
 
 from __future__ import annotations
 
-from .batcher import BacklogFull, MicroBatcher
+from .batcher import (BacklogFull, BatcherClosed, BatcherDraining,
+                      DeadlineExceeded, MicroBatcher)
+from .breaker import CircuitOpen, ServeBreaker
 from .engine import EngineUnsupported, PredictorEngine
-from .registry import ModelRegistry, NoModelError, ServedModel
+from .registry import (ArtifactVerificationError, ModelRegistry,
+                       NoModelError, ServedModel)
 from .server import Server, start_http
 
 __all__ = [
-    "BacklogFull", "EngineUnsupported", "MicroBatcher", "ModelRegistry",
-    "NoModelError", "PredictorEngine", "ServedModel", "Server",
+    "ArtifactVerificationError", "BacklogFull", "BatcherClosed",
+    "BatcherDraining", "CircuitOpen", "DeadlineExceeded",
+    "EngineUnsupported", "MicroBatcher", "ModelRegistry", "NoModelError",
+    "PredictorEngine", "ServeBreaker", "ServedModel", "Server",
     "start_http",
 ]

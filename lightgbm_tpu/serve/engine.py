@@ -401,6 +401,102 @@ class PredictorEngine:
         return _finalize_score(score, k, self.objective,
                                self.average_output, 0, t1, raw_score)
 
+    # -- verification ------------------------------------------------------
+    def _probe_candidates(self) -> List[np.ndarray]:
+        """Per-feature probe values aimed at the engine's risk surface:
+        the model's own split thresholds (exact tie inputs — the values
+        f32 rounding would misroute), midpoints between consecutive
+        thresholds, out-of-range values, NaN, and every categorical's
+        in/out-of-set and unseen values."""
+        cands: List[np.ndarray] = []
+        for tab in self.tables:
+            if tab.kind == "num" and len(tab.thresholds):
+                t = tab.thresholds
+                mids = (t[:-1] + t[1:]) / 2.0 if len(t) > 1 \
+                    else np.empty(0)
+                c = np.concatenate([t, mids, [t[0] - 1.0, t[-1] + 1.0,
+                                              0.0, np.nan]])
+            elif tab.kind == "cat" and len(tab.cats):
+                c = np.concatenate([tab.cats.astype(np.float64),
+                                    [tab.cats[-1] + 1.0, -1.0, np.nan]])
+            else:
+                c = np.zeros(1)
+            cands.append(c)
+        return cands
+
+    def _f32_consensus_mask(self, x: np.ndarray) -> np.ndarray:
+        """Rows whose f32 on-device binning provably agrees with the
+        exact f64 binning — only those can be byte-compared against the
+        host walk (``serve_device_binning`` documents tie inexactness
+        as the mode's accepted cost, so tie rows prove nothing)."""
+        exact = self.bin_rows(x)
+        ok = np.ones(len(x), bool)
+        for f, tab in enumerate(self.tables):
+            if tab.kind != "num" or not len(tab.thresholds):
+                continue
+            v = x[:, f]
+            isnan = np.isnan(v)
+            # mirror bin_rows_device: f32 value vs f32 threshold table;
+            # NaN takes the f64-derived na/zero fallback, never f32 ops
+            b32 = np.searchsorted(
+                tab.thresholds.astype(np.float32),
+                np.where(isnan, 0.0, v).astype(np.float32),
+                side="left").astype(np.int64)
+            nan_bin = tab.na_bin if tab.miss_nan else np.searchsorted(
+                tab.thresholds, 0.0, side="left")
+            b32 = np.where(isnan, nan_bin, b32)
+            ok &= b32 == exact[:, f]
+        return ok
+
+    def self_check(self, max_rows: int = 64,
+                   max_total_rows: int = 4096,
+                   device_binning: bool = False) -> bool:
+        """Post-build parity canary: traverse deterministic probe
+        batches on the device and require the scores to be
+        byte-identical to the host tree walk
+        (``Tree.predict_leaf`` leaves fed through the SAME
+        :meth:`raw_scores` accumulation, so the comparison isolates
+        exactly the device traversal + binning).  Probes run in
+        ``max_rows`` chunks until EVERY feature's candidate list has
+        cycled through (capped at ``max_total_rows`` for pathological
+        models), so all thresholds are exercised, not just the first
+        chunk's worth.  ``device_binning`` additionally verifies the
+        f32 on-device binning path the server will actually use under
+        ``serve_device_binning`` — restricted to probe rows where f32
+        and f64 binning provably agree (tie rows are the mode's
+        documented inexactness, not an engine defect); a model device
+        binning cannot represent at all (categoricals) raises
+        :class:`EngineUnsupported` out of this check, which
+        registry.load treats as failed.  True = verified; False = the
+        compiled artifact disagrees with the model it was built from
+        (a flattening bug, a device numeric surprise) — callers fall
+        back to the host walk rather than serve wrong predictions
+        (serve/registry.py)."""
+        cands = self._probe_candidates()
+        if not cands or not self.trees:
+            return True
+        total = min(max(len(c) for c in cands), max_total_rows)
+        for off in range(0, total, max_rows):
+            rows = min(max_rows, total - off)
+            probe = np.zeros((rows, self.num_features), np.float64)
+            idx = off + np.arange(rows)
+            for f, c in enumerate(cands):
+                probe[:, f] = c[idx % len(c)]
+            host_leaves = np.stack(
+                [t.predict_leaf(probe) for t in self.trees],
+                axis=1).astype(np.int32)
+            host = self.raw_scores(probe, leaves=host_leaves)
+            if not np.array_equal(self.raw_scores(probe), host):
+                return False
+            if device_binning:
+                mask = self._f32_consensus_mask(probe)
+                if mask.any() and not np.array_equal(
+                        self.raw_scores(probe[mask],
+                                        device_binning=True),
+                        host[mask]):
+                    return False
+        return True
+
     # -- introspection -----------------------------------------------------
     def compile_stats(self) -> dict:
         """Bucketed-compile-cache ledger: buckets used (with hit

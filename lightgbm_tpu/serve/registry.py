@@ -14,6 +14,13 @@ newest snapshot of an ``output_model`` whose manifest is present and
 parseable (the manifest-written-last marker of a COMPLETE snapshot) —
 serving has no training dataset, so the params-signature and
 data-fingerprint checks that gate training auto-resume do not apply.
+
+Artifacts are VERIFIED before activation (``verify_artifacts``):
+snapshot/file loads check SHA-256 against the manifest's recorded
+checksum (:class:`ArtifactVerificationError` on mismatch — the current
+version keeps serving), and a freshly built engine must pass its
+byte-parity ``self_check`` probe or serving falls back to the host
+walk.  A failed ``load`` of any kind leaves the registry untouched.
 """
 
 from __future__ import annotations
@@ -25,6 +32,11 @@ from typing import Dict, List, Optional
 
 class NoModelError(RuntimeError):
     """The registry has no active model."""
+
+
+class ArtifactVerificationError(RuntimeError):
+    """A model artifact failed checksum verification — refused, never
+    activated (the current version keeps serving)."""
 
 
 class ServedModel:
@@ -51,7 +63,9 @@ class ServedModel:
 
 class ModelRegistry:
     def __init__(self, *, max_batch: Optional[int] = None,
-                 min_bucket: int = 16, build_engine: bool = True):
+                 min_bucket: int = 16, build_engine: bool = True,
+                 verify_artifacts: bool = True,
+                 device_binning: bool = False):
         self._models: Dict[str, ServedModel] = {}
         self._current: Optional[ServedModel] = None
         self._lock = threading.Lock()
@@ -59,31 +73,110 @@ class ModelRegistry:
         self._engine_opts = {"max_batch": max_batch,
                              "min_bucket": min_bucket}
         self._build_engine = build_engine
+        self._verify = verify_artifacts
+        # the server will serve via the f32 device-binning path
+        # (serve_device_binning): self-checks must verify THAT path,
+        # not just the host-binned one
+        self._device_binning = device_binning
 
     # -- loading -----------------------------------------------------------
     def load(self, model_file: Optional[str] = None,
              model_str: Optional[str] = None, booster=None,
              version: Optional[str] = None, source: str = "",
-             activate: bool = True) -> str:
+             activate: bool = True,
+             expected_sha256: Optional[str] = None) -> str:
         """Load one model (exactly one of file / string / booster),
-        register it, and (by default) atomically make it current."""
+        register it, and (by default) atomically make it current.
+
+        Verification (``verify_artifacts``, docs/Serving.md): with
+        ``expected_sha256`` set, the model file's bytes must hash to it
+        or the load raises :class:`ArtifactVerificationError` before
+        anything is registered — a truncated, bit-rotted or
+        wrong-version artifact can never be swapped in.  A freshly
+        built engine must additionally pass its byte-parity
+        ``self_check`` probe against the host tree walk, or it is
+        discarded in favor of the (always-correct) host walk."""
         from ..booster import Booster
+        from ..utils import faultinject
+        from ..utils.log import Log
+        # reload fault-injection site (tools/soak_serve.py chaos): a
+        # failed load must leave the registry — and the current
+        # version — exactly as they were
+        faultinject.check("serve_reload")
         if sum(a is not None
                for a in (model_file, model_str, booster)) != 1:
             raise ValueError("load needs exactly one of model_file, "
                              "model_str, booster")
+        if booster is not None and expected_sha256 is not None:
+            # a live Booster has no byte artifact to hash — accepting
+            # the pin silently would fake verification
+            raise ValueError("expected_sha256 requires model_file or "
+                             "model_str, not a live booster")
+        if expected_sha256 is not None and not expected_sha256:
+            # an empty pin is an unset variable in the caller's deploy
+            # script, not a request to skip verification — falling
+            # through to the unverified branch would fake enforcement
+            raise ValueError("expected_sha256 must be a non-empty "
+                             "SHA-256 hex digest (got '')")
         if booster is None:
-            booster = Booster(model_file=model_file, model_str=model_str)
+            if expected_sha256:
+                # an EXPLICIT pin is always enforced — verify_artifacts
+                # gates only the automatic checks (snapshot-manifest
+                # checksums, engine self-check); skipping a pin the
+                # caller spelled out would fake verification.  A pinned
+                # file is read ONCE: the bytes that hashed clean are the
+                # bytes that get parsed, so a file swapped on disk after
+                # the hash can never be activated unverified.
+                from ..snapshot import sha256_hex
+                if model_file is not None:
+                    with open(model_file, "rb") as f:
+                        data = f.read()
+                    got = sha256_hex(data)
+                else:
+                    got = sha256_hex(model_str)
+                if got != expected_sha256:
+                    raise ArtifactVerificationError(
+                        f"model artifact "
+                        f"{model_file or '<model_str>'} checksum "
+                        f"mismatch (got {got[:12]}…, expected "
+                        f"{expected_sha256[:12]}…); refusing to load")
+                if model_file is not None:
+                    model_str = data.decode("utf-8")
+                booster = Booster(model_str=model_str)
+            else:
+                booster = Booster(model_file=model_file,
+                                  model_str=model_str)
             source = source or (model_file or "<model_str>")
         else:
             source = source or "<booster>"
         engine = None
         if self._build_engine:
-            from ..utils.log import Log
             from .engine import EngineUnsupported, PredictorEngine
             try:
                 engine = PredictorEngine.from_booster(booster,
                                                       **self._engine_opts)
+                if self._verify:
+                    try:
+                        ok = engine.self_check(
+                            device_binning=self._device_binning)
+                    except Exception as e:  # noqa: BLE001 — a probe
+                        # that cannot RUN (device blip during reload)
+                        # must not fail a load the host walk can serve
+                        Log.warning(f"serve: engine self-check errored "
+                                    f"for {source} ({e}); treating as "
+                                    "failed")
+                        ok = False
+                    if not ok:
+                        # the compiled artifact disagrees with the
+                        # model it came from (or could not be proven):
+                        # never serve it — the host walk is the oracle
+                        # the parity tests trust, fall back to it
+                        Log.warning(
+                            f"serve: engine self-check FAILED for "
+                            f"{source}; discarding engine, serving via "
+                            "host walk")
+                        engine = None
+                        booster._engine_cache = False
             except EngineUnsupported as e:
                 # an engine-unsupported model is still SERVABLE — the
                 # batch path falls back to the host walk exactly like
@@ -96,7 +189,8 @@ class ModelRegistry:
                 # on the serve path then rides the same bucketed cache,
                 # and the engine's compile ledger (surfaced via
                 # /metrics) sees every batch
-                booster._engine_cache = engine
+                if engine is not None:
+                    booster._engine_cache = engine
         with self._lock:
             if version is None:
                 version = f"v{self._next_version}"
@@ -112,18 +206,47 @@ class ModelRegistry:
 
     def load_snapshot(self, output_model: str,
                       version: Optional[str] = None,
-                      activate: bool = True) -> str:
+                      activate: bool = True,
+                      expected_sha256: Optional[str] = None) -> str:
         """Load the newest COMPLETE snapshot of ``output_model``
-        (manifest present + parseable, snapshot.py)."""
+        (manifest present + parseable + checksum-verified,
+        snapshot.py).  The manifest's recorded ``model_sha256`` is also
+        re-verified at load time, so a file swapped between the lookup
+        and the read is still refused.  An explicit ``expected_sha256``
+        pin takes precedence over the manifest's checksum: the caller
+        vetted a specific artifact, and a snapshot that hashes clean
+        against its own manifest but is not THAT artifact must be
+        refused, not activated."""
+        import json
+
         from ..snapshot import find_latest_complete_snapshot
-        found = find_latest_complete_snapshot(output_model)
+        found = find_latest_complete_snapshot(output_model,
+                                              verify=self._verify)
         if found is None:
             raise FileNotFoundError(
                 f"no complete snapshot of {output_model!r} found")
         it, path = found
+        expected = expected_sha256
+        if expected is None and self._verify:
+            try:
+                # utf-8 like every artifact read (the manifest is
+                # ASCII-escaped JSON today, but the convention is one
+                # encoding on both sides of every checksummed file)
+                with open(path + ".manifest.json",
+                          encoding="utf-8") as f:
+                    expected = json.load(f).get("model_sha256")
+            except (OSError, ValueError) as e:
+                # the manifest the finder JUST parsed is gone or torn
+                # (pruned mid-load, bit rot): refuse — silently loading
+                # with expected=None would be exactly the unverified
+                # activation serve_verify_artifacts exists to prevent
+                raise ArtifactVerificationError(
+                    f"snapshot manifest {path}.manifest.json became "
+                    f"unreadable mid-load ({e}); refusing unverified "
+                    "activation") from e
         return self.load(model_file=path, version=version,
                          source=f"{path} (snapshot iter {it})",
-                         activate=activate)
+                         activate=activate, expected_sha256=expected)
 
     # -- swap / lookup -----------------------------------------------------
     def activate(self, version: str) -> None:

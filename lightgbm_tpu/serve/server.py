@@ -18,12 +18,21 @@ objective/feature matrix).
 ``start_http`` exposes the same Server over a stdlib-only
 ``ThreadingHTTPServer``:
 
-- ``POST /predict``  ``{"rows": [[...], ...]}`` ->
+- ``POST /predict``  ``{"rows": [[...], ...], "deadline_ms": ...}`` ->
   ``{"predictions": ..., "model_version": ..., "num_rows": ...}``;
-  429 + ``Retry-After`` on backpressure, 400 on malformed input.
-- ``POST /reload``   ``{"model_file": ...}`` (or ``{"snapshot": out}``)
-  -> hot swap, in-flight requests finish on the old version.
-- ``GET /healthz``   liveness + current model version + queue depth.
+  429 + ``Retry-After`` on backpressure, 503 + ``Retry-After`` while
+  the circuit breaker is open, 504 past the deadline, 503 while
+  draining, 400 on malformed input.
+- ``POST /reload``   ``{"model_file": ...}`` (or ``{"snapshot": out}``,
+  optional ``"sha256"`` to pin the artifact) -> hot swap, in-flight
+  requests finish on the old version; 409 on checksum mismatch (the
+  current version keeps serving).
+- ``POST /drain``    graceful shutdown prologue: refuse new work,
+  finish queued work within ``serve_drain_s``; ``/healthz`` flips to
+  503 so load balancers stop routing here.
+- ``GET /healthz``   readiness + current model version + queue depth +
+  breaker state: 200 while ``ok``/``degraded``, 503 when draining or
+  model-less.
 - ``GET /metrics``   deterministic JSON metrics snapshot
   (``serve.latency`` quantiles included) + engine compile stats.
 
@@ -43,8 +52,10 @@ import numpy as np
 from ..config import Config
 from ..utils.log import Log
 from ..utils.resilience import RetryPolicy
-from .batcher import BacklogFull, MicroBatcher
-from .registry import ModelRegistry, NoModelError
+from .batcher import (BacklogFull, BatcherClosed, DeadlineExceeded,
+                      MicroBatcher)
+from .breaker import CircuitOpen, ServeBreaker
+from .registry import ArtifactVerificationError, ModelRegistry, NoModelError
 
 
 class Server:
@@ -63,7 +74,9 @@ class Server:
         self.tracer = self.obs.tracer if self.obs is not None else None
         self.registry = ModelRegistry(
             max_batch=cfg.serve_max_batch,
-            min_bucket=cfg.serve_min_bucket)
+            min_bucket=cfg.serve_min_bucket,
+            verify_artifacts=cfg.serve_verify_artifacts,
+            device_binning=cfg.serve_device_binning)
         model_file = model_file or (cfg.input_model or None)
         if booster is not None or model_file or model_str:
             self.registry.load(model_file=model_file,
@@ -72,6 +85,11 @@ class Server:
             # serve the newest complete snapshot of a (possibly still
             # running) training job
             self.registry.load_snapshot(cfg.output_model)
+        self.breaker = ServeBreaker(
+            failures=cfg.serve_breaker_failures,
+            cooldown_ms=cfg.serve_breaker_cooldown_ms,
+            metrics=self.metrics) \
+            if cfg.serve_breaker_failures > 0 else None
         self.batcher = MicroBatcher(
             self._predict_batch,
             max_batch=cfg.serve_max_batch,
@@ -83,12 +101,16 @@ class Server:
             retry_policy=RetryPolicy(
                 max_attempts=max(1, cfg.serve_retries + 1),
                 base_delay_s=0.02, max_delay_s=0.25),
+            default_deadline_ms=cfg.serve_deadline_ms,
+            breaker=self.breaker,
             metrics=self.metrics, tracer=self.tracer)
         self._t0 = time.time()
         self._closed = False
 
     # -- batch execution (worker thread) -----------------------------------
     def _predict_batch(self, rows: np.ndarray) -> Tuple[np.ndarray, dict]:
+        from ..utils import faultinject
+        faultinject.check("serve_batch")   # chaos site (soak harness)
         served = self.registry.current()   # resolved per batch: requests
         # already in this batch finish on it even if a reload lands now
         if self.config.serve_device_binning and served.engine is not None:
@@ -98,35 +120,83 @@ class Server:
         return np.asarray(out), {"model_version": served.version}
 
     # -- client surface ----------------------------------------------------
-    def predict(self, rows, timeout: Optional[float] = None) -> np.ndarray:
+    def predict(self, rows, timeout: Optional[float] = None,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
         """Predict through the micro-batching queue; blocks for the
         result.  Raises :class:`~.batcher.BacklogFull` under
-        backpressure."""
-        return self.submit(rows).result(timeout)
+        backpressure, :class:`~.breaker.CircuitOpen` while the breaker
+        is open, :class:`~.batcher.DeadlineExceeded` past the
+        deadline."""
+        return self.submit(rows, deadline_ms=deadline_ms).result(timeout)
 
-    def submit(self, rows):
+    def submit(self, rows, deadline_ms: Optional[float] = None):
         """Enqueue and return the :class:`PredictionFuture` (the
-        non-blocking form of :meth:`predict`)."""
+        non-blocking form of :meth:`predict`).  ``deadline_ms``
+        overrides the ``serve_deadline_ms`` default for this request."""
         span = (self.tracer.span("serve.request", rows=len(rows))
                 if self.tracer is not None else None)
-        fut = self.batcher.submit(np.asarray(rows, np.float64))
-        if span is not None:
-            span.end()
-        return fut
+        try:
+            return self.batcher.submit(np.asarray(rows, np.float64),
+                                       deadline_ms=deadline_ms)
+        finally:
+            # rejected submissions (breaker open, backlog, deadline,
+            # draining) are exactly the events an outage trace needs —
+            # the span must emit on every path
+            if span is not None:
+                span.end()
 
     def reload(self, model_file: Optional[str] = None,
                model_str: Optional[str] = None, booster=None,
-               snapshot: Optional[str] = None) -> str:
+               snapshot: Optional[str] = None,
+               expected_sha256: Optional[str] = None,
+               version: Optional[str] = None) -> str:
         """Load a new model version and atomically swap it in; returns
-        the new version id."""
-        if snapshot is not None:
-            version = self.registry.load_snapshot(snapshot)
-        else:
-            version = self.registry.load(model_file=model_file,
-                                         model_str=model_str,
-                                         booster=booster)
+        the new version id (auto-assigned unless ``version`` names
+        one).  A failed load (unreadable file, checksum mismatch,
+        injected fault) leaves the current version serving and counts
+        ``serve.reload_failures``."""
+        try:
+            if snapshot is not None:
+                version = self.registry.load_snapshot(
+                    snapshot, version=version,
+                    expected_sha256=expected_sha256)
+            else:
+                version = self.registry.load(
+                    model_file=model_file, model_str=model_str,
+                    booster=booster, expected_sha256=expected_sha256,
+                    version=version)
+        except BaseException:
+            self.metrics.counter("serve.reload_failures").inc()
+            raise
         Log.info(f"serve: activated model {version}")
         return version
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self.batcher.draining
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful shutdown prologue: refuse new work, finish what is
+        queued (bounded by ``timeout_s``, default ``serve_drain_s``),
+        report the outcome.  The server stays alive (health answers,
+        metrics export) until :meth:`close` — the LB-friendly sequence
+        is drain, observe ``/healthz`` flip to 503, then close."""
+        try:
+            timeout_s = self.config.serve_drain_s if timeout_s is None \
+                else float(timeout_s)
+        except (TypeError, ValueError):
+            timeout_s = self.config.serve_drain_s
+        self.batcher.begin_drain()
+        drained = self.batcher.wait_idle(timeout_s)
+        leftover = self.batcher.depth_rows
+        if drained:
+            Log.info("serve: drained (all accepted requests answered)")
+        else:
+            Log.warning(f"serve: drain timed out after {timeout_s:g}s "
+                        f"({leftover} rows still queued)")
+        return {"drained": drained, "leftover_rows": leftover,
+                "timeout_s": timeout_s}
 
     def health(self) -> dict:
         try:
@@ -134,12 +204,33 @@ class Server:
             status = "ok"
         except NoModelError:
             model, status = None, "no_model"
-        return {"status": status, "model": model,
-                "queue_depth_rows": self.batcher.depth_rows,
-                "uptime_s": round(time.time() - self._t0, 3),
-                "versions": self.registry.versions()}
+        if self.batcher.draining or self._closed:
+            status = "draining" if not self._closed else "stopped"
+        elif status == "ok" and self.breaker is not None \
+                and self.breaker.state() != "closed":
+            # the device side is failing (or on probation): alive, but
+            # a load balancer should prefer healthier replicas
+            status = "degraded"
+        out = {"status": status,
+               # readiness: may an LB route NEW traffic here?  Degraded
+               # stays ready — the breaker's half-open probe IS a
+               # client request, so draining a degraded replica would
+               # starve it of the traffic that closes the circuit
+               "ready": status in ("ok", "degraded"),
+               "model": model,
+               "queue_depth_rows": self.batcher.depth_rows,
+               "uptime_s": round(time.time() - self._t0, 3),
+               "versions": self.registry.versions()}
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.describe()
+        return out
 
     def metrics_snapshot(self) -> dict:
+        if self.breaker is not None:
+            # the OPEN->HALF_OPEN transition is lazy (clock-driven, no
+            # event): refresh so an idle replica's exported state can't
+            # go stale against /healthz
+            self.breaker.refresh_gauge()
         snap = dict(self.metrics.snapshot())
         lat = snap.get("serve.latency")
         if lat and lat.get("count"):
@@ -213,7 +304,13 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, server.health())
+                h = server.health()
+                # readiness semantics for load balancers: 200 only
+                # while NEW traffic should be routed here; a draining
+                # or model-less replica answers (liveness) with 503.
+                # health() computes "ready" — route on it so code and
+                # body can never disagree
+                self._send(200 if h["ready"] else 503, h)
             elif self.path == "/metrics":
                 self._send(200, server.metrics_snapshot())
             else:
@@ -230,6 +327,8 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 self._predict(req)
             elif self.path == "/reload":
                 self._reload(req)
+            elif self.path == "/drain":
+                self._send(200, server.drain(req.get("timeout_s")))
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -248,14 +347,43 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
             except (ValueError, TypeError) as e:
                 self._send(400, {"error": f"bad rows: {e}"})
                 return
+            deadline_ms = req.get("deadline_ms")
+            timeout_s = req.get("timeout_s", 30.0)
             try:
-                fut = server.submit(arr)
-                pred = fut.result(timeout=req.get("timeout_s", 30.0))
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+                timeout_s = float(timeout_s)
+            except (ValueError, TypeError) as e:
+                # malformed knobs are the client's fault — 400, like
+                # bad rows, not the catch-all 500 below
+                self._send(400, {"error": f"bad deadline_ms or "
+                                          f"timeout_s: {e}"})
+                return
+            try:
+                fut = server.submit(arr, deadline_ms=deadline_ms)
+                pred = fut.result(timeout=timeout_s)
             except BacklogFull as e:
                 self._send(429, {"error": str(e),
                                  "retry_after_ms": e.retry_after_ms},
                            headers={"Retry-After": str(max(
                                1, int(e.retry_after_ms / 1000 + 0.5)))})
+                return
+            except CircuitOpen as e:
+                # the device side is failing: reject up front with the
+                # breaker's cooldown as the back-off hint
+                self._send(503, {"error": str(e),
+                                 "retry_after_ms": e.retry_after_ms},
+                           headers={"Retry-After": str(max(
+                               1, int(e.retry_after_ms / 1000 + 0.5)))})
+                return
+            except DeadlineExceeded as e:
+                self._send(504, {"error": str(e),
+                                 "deadline_ms": e.deadline_ms,
+                                 "where": e.where})
+                return
+            except BatcherClosed as e:       # draining or shut down
+                self._send(503, {"error": str(e),
+                                 "draining": server.draining})
                 return
             except NoModelError as e:
                 self._send(503, {"error": str(e)})
@@ -280,7 +408,14 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 version = server.reload(
                     model_file=req.get("model_file"),
                     model_str=req.get("model_str"),
-                    snapshot=req.get("snapshot"))
+                    snapshot=req.get("snapshot"),
+                    expected_sha256=req.get("sha256"))
+            except ArtifactVerificationError as e:
+                # the artifact is not what the caller said it was —
+                # conflict, not client-syntax error; current version
+                # keeps serving
+                self._send(409, {"error": str(e)})
+                return
             except Exception as e:          # noqa: BLE001 — operator call
                 self._send(400,
                            {"error": f"{type(e).__name__}: {e}"})

@@ -9,7 +9,9 @@ wedge, no way to continue the run) this module closes the loop:
   f32 training score, so a resumed run continues from the EXACT device
   state rather than a re-predicted approximation of it) and a
   ``.manifest.json`` sidecar (iteration, params signature, data
-  fingerprint).  All three go through ``resilience.atomic_write``; the
+  fingerprint, SHA-256 checksums of the model and state bytes — readers
+  verify the artifacts they find are the artifacts the manifest
+  describes).  All three go through ``resilience.atomic_write``; the
   manifest is written LAST, so its presence marks a complete snapshot —
   a crash mid-snapshot leaves the previous snapshot as the newest valid
   one.  Old snapshots are pruned to ``snapshot_keep``.
@@ -72,6 +74,49 @@ def params_signature(params: Dict[str, Any]) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
+def sha256_hex(data) -> str:
+    """SHA-256 of ``data`` (str encoded as UTF-8)."""
+    if isinstance(data, str):
+        data = data.encode()
+    return hashlib.sha256(data).hexdigest()
+
+
+def file_sha256(path) -> str:
+    """Streamed SHA-256 of a file's bytes."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_snapshot_artifacts(path: str, man: Dict[str, Any],
+                              state: bool = True) -> Optional[str]:
+    """Check the snapshot's files against the checksums its manifest
+    recorded; returns an error string on mismatch/unreadable, None when
+    everything matches.  Manifests written before checksums existed
+    record none — they verify vacuously (presence of the
+    manifest-written-last marker is still the completeness signal).
+    ``state=False`` skips the ``.state.npz`` sidecar: serving never
+    reads it, so a reader that only needs the model must neither pay
+    its hashing I/O nor refuse an otherwise servable snapshot over it."""
+    pairs = [("model_sha256", path)]
+    if state:
+        pairs.append(("state_sha256", path + ".state.npz"))
+    for key, p in pairs:
+        want = man.get(key)
+        if not want:
+            continue
+        try:
+            got = file_sha256(p)
+        except OSError as e:
+            return f"{os.path.basename(p)} unreadable ({e})"
+        if got != want:
+            return (f"{os.path.basename(p)} checksum mismatch "
+                    f"(file {got[:12]}…, manifest {want[:12]}…)")
+    return None
+
+
 def _snapshot_path(output_model: str, iteration: int) -> str:
     return f"{output_model}.snapshot_iter_{iteration}"
 
@@ -108,6 +153,10 @@ def write_snapshot(booster, prev_booster, cfg, iteration: int,
     score = np.asarray(booster._model.score, np.float32)
     buf = io.BytesIO()
     np.savez_compressed(buf, score=score)
+    # encode ONCE and write binary: the hashed bytes must be the
+    # written bytes (text mode would re-encode under the locale's
+    # charset / newline rules, desynchronizing the checksum)
+    text_bytes = text.encode("utf-8")
     manifest = {
         "format": _FORMAT,
         "iteration": int(iteration),
@@ -117,8 +166,14 @@ def write_snapshot(booster, prev_booster, cfg, iteration: int,
         "num_class": int(score.shape[1]) if score.ndim > 1 else 1,
         "model_file": os.path.basename(base),
         "state_file": os.path.basename(base) + ".state.npz",
+        # artifact checksums, computed from the EXACT bytes written
+        # below: a reader (training resume, serving hot-load) can prove
+        # the files it found are the files this manifest describes —
+        # bit rot and torn/foreign files are refused, not loaded
+        "model_sha256": sha256_hex(text_bytes),
+        "state_sha256": sha256_hex(buf.getvalue()),
     }
-    atomic_write(base, text)
+    atomic_write(base, text_bytes, binary=True)
     atomic_write(base + ".state.npz", buf.getvalue(), binary=True)
     # manifest last: its presence marks the snapshot complete
     atomic_write(base + ".manifest.json",
@@ -139,7 +194,7 @@ def prune_snapshots(output_model: str, keep: int) -> None:
                 pass
 
 
-def find_latest_complete_snapshot(output_model: str
+def find_latest_complete_snapshot(output_model: str, verify: bool = True
                                   ) -> Optional[Tuple[int, str]]:
     """Newest snapshot of ``output_model`` whose manifest is present,
     parseable and format-matching, as ``(iteration, model_path)`` — the
@@ -147,10 +202,20 @@ def find_latest_complete_snapshot(output_model: str
     :func:`find_latest_snapshot`, no params-signature or
     data-fingerprint check applies because a serving process has
     neither; the manifest-written-last marker alone distinguishes a
-    complete snapshot from an interrupted write."""
+    complete snapshot from an interrupted write.  ``verify`` gates the
+    manifest-checksum pass over the candidate's MODEL file — the
+    ``.state.npz`` training sidecar is never hashed here because
+    serving never reads it (a bit-rotted state must not block serving
+    an intact model).  ``serve_verify_artifacts=false`` skips the
+    hashing to shave load latency — corrupt candidates are then only
+    caught if they fail to parse.  The find-time hash selects a clean
+    candidate (bit-rotted newest falls back to an older complete
+    snapshot); the loader's pinned re-hash of the same file
+    (registry.load ``expected_sha256``) is a different job — the
+    TOCTOU guarantee that the bytes activated are the bytes verified."""
     for it, path in _list_snapshots(output_model):
         try:
-            with open(path + ".manifest.json") as f:
+            with open(path + ".manifest.json", encoding="utf-8") as f:
                 man = json.load(f)
         except (OSError, ValueError) as e:
             Log.warning(f"snapshot {path} skipped: manifest unreadable "
@@ -160,6 +225,11 @@ def find_latest_complete_snapshot(output_model: str
             Log.warning(f"snapshot {path} skipped: unknown manifest "
                         f"format {man.get('format')!r}")
             continue
+        if verify:
+            err = verify_snapshot_artifacts(path, man, state=False)
+            if err is not None:
+                Log.warning(f"snapshot {path} skipped: {err}")
+                continue
         return it, path
     return None
 
@@ -175,7 +245,7 @@ def find_latest_snapshot(output_model: str, signature: str,
     for it, path in _list_snapshots(output_model):
         man_path = path + ".manifest.json"
         try:
-            with open(man_path) as f:
+            with open(man_path, encoding="utf-8") as f:
                 man = json.load(f)
         except (OSError, ValueError) as e:
             Log.warning(f"snapshot {path} skipped: manifest unreadable "
@@ -192,6 +262,10 @@ def find_latest_snapshot(output_model: str, signature: str,
         if man.get("data_fingerprint") != fp:
             Log.warning(f"snapshot {path} skipped: dataset fingerprint "
                         "differs from the run that wrote it")
+            continue
+        err = verify_snapshot_artifacts(path, man)
+        if err is not None:
+            Log.warning(f"snapshot {path} skipped: {err}")
             continue
         try:
             with np.load(path + ".state.npz") as z:

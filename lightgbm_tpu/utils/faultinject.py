@@ -36,6 +36,13 @@ Sites wired into the codebase:
 ``nan_grads``       gradient poisoning at iteration k
                     (``models/gbdt.GBDTModel.train_one_iter``) —
                     exercises ``finite_check_policy``
+``serve_batch``     serve batch execution (``serve/server.Server
+                    ._predict_batch``) — exercises the batcher's
+                    transient-retry path and the serving circuit
+                    breaker (tools/soak_serve.py chaos windows)
+``serve_reload``    model load/hot-swap entry (``serve/registry
+                    .ModelRegistry.load``) — a failed reload must leave
+                    the current version serving
 ==================  ========================================================
 
 Also exercisable from ``tools/tpu_watch.py`` probes: export
@@ -51,7 +58,8 @@ from typing import Dict, Optional, Tuple
 ENV_VAR = "LGBM_TPU_FAULTS"
 
 KNOWN_SITES = ("device_claim", "collective", "snapshot_write",
-               "snapshot_kill", "nan_grads")
+               "snapshot_kill", "nan_grads", "serve_batch",
+               "serve_reload")
 
 
 class InjectedFault(RuntimeError):

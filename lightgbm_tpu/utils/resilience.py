@@ -12,6 +12,11 @@ training via ``init_model``); here it is one layer:
   exponential backoff with a hard deadline and an exception CLASSIFIER
   (:func:`is_retryable_device_error`): transient device-claim /
   backend-bring-up errors are retried, programming errors are not.
+- :class:`CircuitBreaker` — CLOSED/OPEN/HALF_OPEN state machine with
+  exponentially backed-off half-open probes: where retry protects one
+  call, the breaker protects the caller population from queuing onto a
+  dependency that is down (serve/breaker.py maps it to admission-time
+  rejects).
 - :class:`Watchdog` — arms ``faulthandler`` stack dumps while a blocking
   device call (claim, compile, collective bring-up) is in flight, so a
   wedge produces a traceback instead of silence.
@@ -34,6 +39,7 @@ import os
 import random
 import sys
 import tempfile
+import threading
 import time
 from typing import Callable, Optional
 
@@ -183,6 +189,177 @@ def retry(policy: Optional[RetryPolicy] = None, **retry_kwargs):
 
 
 # ---------------------------------------------------------------------------
+# Circuit breaker: stop hammering a failing dependency
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Thread-safe CLOSED -> OPEN -> HALF_OPEN breaker.
+
+    Retry/backoff (above) protects one CALL; the breaker protects the
+    CALLER POPULATION: once ``failure_threshold`` consecutive failures
+    are recorded the circuit opens and :meth:`allow` answers False —
+    work is rejected up front instead of queuing onto a dependency that
+    is down (the serve batcher maps this to an immediate 503, keeping
+    the bounded queue free for traffic that can succeed).  After
+    ``cooldown_s`` the circuit half-opens: :meth:`allow` admits ONE
+    probe (further callers stay rejected — a burst arriving right at
+    the cooldown boundary must not pile onto the still-unproven
+    dependency; an abandoned probe expires after the current cooldown
+    so a lost outcome cannot wedge the breaker); the probe's recorded
+    outcome decides — success closes the circuit, failure re-opens it
+    with the cooldown DOUBLED (capped at ``cooldown_max_s``), so a
+    dependency that stays down is probed at a decaying rate rather
+    than every cooldown.
+
+    ``failure_threshold <= 0`` disables the breaker entirely (always
+    allows, records nothing).  ``clock`` is injectable for tests.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 1.0,
+                 cooldown_max_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = int(failure_threshold)
+        # floored above zero: with cooldown 0 a tripped circuit is
+        # instantly HALF_OPEN and the probe-expiry test always passes,
+        # so EVERY caller becomes the probe and nothing is ever
+        # rejected — the breaker would silently not exist
+        self.cooldown_s = max(1e-3, float(cooldown_s))
+        self.cooldown_max_s = max(self.cooldown_s, float(cooldown_max_s))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0            # consecutive, while CLOSED
+        self._open_until = 0.0
+        self._cur_cooldown = self.cooldown_s
+        self._probe_t: Optional[float] = None   # outstanding probe start
+        self.opens = 0                # lifetime open transitions
+
+    @property
+    def enabled(self) -> bool:
+        return self.failure_threshold > 0
+
+    def state(self) -> str:
+        """Current state, with the OPEN -> HALF_OPEN clock transition
+        applied (reading the state can move it, like :meth:`allow`)."""
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._state == self.OPEN \
+                and self._clock() >= self._open_until:
+            self._state = self.HALF_OPEN
+            self._probe_t = None
+        return self._state
+
+    def allow(self) -> bool:
+        """Whether new work may proceed right now.  False while OPEN
+        with the cooldown running, and in HALF_OPEN for everyone but
+        the single probe (the first caller after the cooldown; a probe
+        whose outcome never lands expires after the current cooldown)."""
+        return self.try_acquire()[0]
+
+    def try_acquire(self) -> "tuple[bool, bool]":
+        """``(admitted, claimed_probe)`` — :meth:`allow`, additionally
+        reporting whether THIS call claimed the half-open probe slot.
+        A caller whose admitted work can leave the system without a
+        recorded outcome (dropped, shed) must :meth:`release_probe`
+        when that happens, or the breaker stays shut for the full
+        abandoned-probe expiry on a possibly healthy dependency."""
+        if not self.enabled:
+            return True, False
+        with self._lock:
+            st = self._state_locked()
+            if st == self.OPEN:
+                return False, False
+            if st == self.HALF_OPEN:
+                now = self._clock()
+                if self._probe_t is not None \
+                        and now - self._probe_t < self._cur_cooldown:
+                    return False, False
+                self._probe_t = now
+                return True, True
+            return True, False
+
+    def release_probe(self) -> None:
+        """Give back a probe slot claimed by :meth:`try_acquire` whose
+        work will never record an outcome (deadline-shed before
+        dispatch, request-scoped failure): the next caller probes
+        immediately instead of every caller waiting out the
+        abandoned-probe expiry."""
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._probe_t = None
+
+    def retry_after_s(self) -> float:
+        """The Retry-After hint for rejected work: the remaining
+        cooldown while OPEN, the remaining probe window while HALF_OPEN
+        with a probe outstanding (callers rejected then must NOT retry
+        immediately — that is exactly when traffic is being held back),
+        0 otherwise."""
+        with self._lock:
+            return self._retry_after_locked()
+
+    def _retry_after_locked(self) -> float:
+        # the ONE computation of the hint: describe() must report the
+        # same number CircuitOpen carries, or /healthz and the 503
+        # body disagree about when to come back
+        st = self._state_locked()
+        now = self._clock()
+        if st == self.OPEN:
+            return max(0.0, self._open_until - now)
+        if st == self.HALF_OPEN and self._probe_t is not None:
+            return max(0.0, self._probe_t + self._cur_cooldown - now)
+        return 0.0
+
+    def record_success(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._state_locked()
+            if st == self.HALF_OPEN:
+                # probe succeeded: full reset, cooldown back to base
+                self._state = self.CLOSED
+                self._cur_cooldown = self.cooldown_s
+                self._probe_t = None
+            self._failures = 0
+
+    def record_failure(self) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._state_locked()
+            if st == self.HALF_OPEN:
+                # failed probe: re-open with a doubled cooldown
+                self._cur_cooldown = min(self.cooldown_max_s,
+                                         self._cur_cooldown * 2.0)
+                self._trip_locked()
+            elif st == self.CLOSED:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    self._trip_locked()
+            # already OPEN: late failures from in-flight work don't
+            # extend the cooldown (they predate the trip)
+
+    def _trip_locked(self) -> None:
+        self._state = self.OPEN
+        self._failures = 0
+        self._open_until = self._clock() + self._cur_cooldown
+        self._probe_t = None
+        self.opens += 1
+
+    def describe(self) -> dict:
+        with self._lock:
+            retry_after = self._retry_after_locked()
+            return {"state": self._state,
+                    "consecutive_failures": self._failures,
+                    "opens": self.opens,
+                    "cooldown_s": self._cur_cooldown,
+                    "retry_after_s": retry_after}
+
+
+# ---------------------------------------------------------------------------
 # Watchdog: faulthandler stack dumps for wedged blocking calls
 # ---------------------------------------------------------------------------
 
@@ -251,7 +428,11 @@ def atomic_write(path, data, binary: bool = False) -> None:
                                prefix=os.path.basename(path) + ".",
                                suffix=".tmp")
     try:
-        with os.fdopen(fd, "wb" if binary else "w") as f:
+        # text mode pins utf-8: readers (Booster model load, manifest
+        # json) decode utf-8, and a locale-dependent write encoding
+        # would break the byte checksums recorded over these files
+        with os.fdopen(fd, "wb" if binary else "w",
+                       encoding=None if binary else "utf-8") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
