@@ -132,7 +132,15 @@ def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
     explicit K pins the grower's super-step width (grower.py).
     learner: pin tpu_learner (CPU fallback auto-selects the partitioned
     host-driven learner, which never batches splits — pass "masked" to
-    measure the super-step path on CPU)."""
+    measure the super-step path on CPU).
+
+    The returned ``stats`` dict carries the first-class compile
+    metrics (ROADMAP item 4): ``compile_s`` — wall time of the first
+    chunk/iteration including XLA trace+compile (warm-started by the
+    persistent cache when enabled), ``trace_count`` — library jit
+    traces this point added, and the process compile/cache counters
+    delta (utils/compile_cache.py)."""
+    from lightgbm_tpu.utils.compile_cache import compile_stats, trace_total
     params = {
         "objective": "binary", "num_leaves": num_leaves,
         "learning_rate": 0.1, "max_bin": max_bin,
@@ -147,6 +155,7 @@ def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
         ds.construct()
     t_bin = time.time() - t0
 
+    traces0, cs0 = trace_total(), compile_stats()
     bst = lgb.Booster(params=dict(params, fused_chunk=chunk),
                       train_set=ds)
     m = bst._model
@@ -174,15 +183,24 @@ def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
     dt = time.time() - t0
     iters = m.iter_ - start_iter
     ips = iters / max(dt, 1e-9)
+    cs1 = compile_stats()
+    stats = {
+        "compile_s": round(t_compile, 2),
+        "trace_count": trace_total() - traces0,
+        "backend_compiles": cs1["count"] - cs0["count"],
+        "compile_cache_hits": cs1["cache_hits"] - cs0["cache_hits"],
+    }
 
     from lightgbm_tpu.metrics import _auc
     auc = _auc(y, np.asarray(m.train_score())[:, 0], None)
     steps = m.step_counts[-min(len(m.step_counts), 8):]
     print(f"[bench] {tag}: bin={t_bin:.1f}s compile+warm={t_compile:.1f}s "
+          f"(traces={stats['trace_count']}, "
+          f"cache_hits={stats['compile_cache_hits']}) "
           f"steady={dt:.1f}s/{iters} iters -> {ips:.3f} iters/s "
           f"(train-AUC={auc:.4f}, fused={fused}, steps/tree={steps[-1] if steps else '?'})",
           file=sys.stderr, flush=True)
-    return ips, auc, ds, steps
+    return ips, auc, ds, steps, stats
 
 
 def _claim_device(cpu: bool):
@@ -222,7 +240,7 @@ def child_primary() -> None:
     # primary: 1M x 28, 31 leaves, 8-way batched super-steps (the
     # framework's fast growth mode; AUC reported alongside so quality is
     # auditable against the strict point below)
-    ips1, auc1, ds1, steps1 = _train_point(
+    ips1, auc1, ds1, steps1, stats1 = _train_point(
         lgb, x, y, num_leaves=PRIMARY_LEAVES,
         chunk=4 if quick else 25, n_chunks=1 if quick else 4,
         tag="1M/31leaf/sb8", split_batch=8)
@@ -241,7 +259,8 @@ def child_primary() -> None:
     # persist + emit the primary record NOW: a later timeout kill (or a
     # hang in the strict point) must not discard it
     _record_point("primary", auc=round(float(auc1), 4), cpu=cpu,
-                  steps_per_tree=steps1[-1] if steps1 else None, **rec)
+                  steps_per_tree=steps1[-1] if steps1 else None,
+                  **stats1, **rec)
     print(json.dumps(rec), flush=True)
 
     # observability: achieved histogram FLOP/s + MFU estimate
@@ -256,13 +275,13 @@ def child_primary() -> None:
         # strict leaf-wise growth (split_batch=1): round-over-round
         # comparable with BENCH_r02/r03 history + the AUC quality anchor
         try:
-            ips0, auc0, _, _ = _train_point(lgb, x, y,
-                                            num_leaves=PRIMARY_LEAVES,
-                                            chunk=25, n_chunks=2,
-                                            tag="1M/31leaf/strict", ds=ds1,
-                                            split_batch=1)
+            ips0, auc0, _, _, st0 = _train_point(lgb, x, y,
+                                                 num_leaves=PRIMARY_LEAVES,
+                                                 chunk=25, n_chunks=2,
+                                                 tag="1M/31leaf/strict",
+                                                 ds=ds1, split_batch=1)
             _record_point("higgs1m_31leaf_strict", value=round(ips0, 3),
-                          auc=round(float(auc0), 4))
+                          auc=round(float(auc0), 4), **st0)
         except Exception as e:
             _record_point("higgs1m_31leaf_strict",
                           error=f"{type(e).__name__}: {e}"[:200])
@@ -292,7 +311,7 @@ def child_extras() -> None:
     # balanced 255-leaf tree at K=16 (vs 254 for the old static loop).
     ds2 = ips2 = None
     try:
-        ips2, auc2, ds2, st2 = _train_point(
+        ips2, auc2, ds2, st2, cst2 = _train_point(
             lgb, x, y, num_leaves=255, chunk=4,
             n_chunks=2, tag=f"{n//1000}k/255leaf", learner=learner)
         flops = _hist_flops_per_iter(n, 255) * ips2
@@ -303,7 +322,8 @@ def child_extras() -> None:
                       vs_baseline=(round(ips2 / BASELINE_IPS, 3)
                                    if not cpu else None),
                       hist_tflops=round(flops / 1e12, 2),
-                      mfu=round(flops / peak, 4) if peak else None)
+                      mfu=round(flops / peak, 4) if peak else None,
+                      **cst2)
     except Exception as e:
         _record_point("higgs1m_255leaf",
                       error=f"{type(e).__name__}: {e}"[:200])
@@ -314,12 +334,13 @@ def child_extras() -> None:
     try:
         ne, fe = (400_000, 2000) if not cpu else (40_000, 500)
         xe, ye = make_epsilon_like(ne, fe)
-        ipse, auce, _, _ = _train_point(
+        ipse, auce, _, _, cste = _train_point(
             lgb, xe, ye, num_leaves=PRIMARY_LEAVES, chunk=4, n_chunks=2,
             tag=f"{ne//1000}k/{fe}f/31leaf", split_batch=8,
             learner=learner)
         _record_point("epsilon400k_2000f", value=round(ipse, 3), cpu=cpu,
-                      shape=f"{ne}x{fe}", auc=round(float(auce), 4))
+                      shape=f"{ne}x{fe}", auc=round(float(auce), 4),
+                      **cste)
         del xe, ye
     except Exception as e:
         _record_point("epsilon400k_2000f",
@@ -331,7 +352,7 @@ def child_extras() -> None:
     # ~254 passes/tree makes this the slowest point; it runs last.
     if ds2 is not None:
         try:
-            ips2s, _, _, st2s = _train_point(
+            ips2s, _, _, st2s, cst2s = _train_point(
                 lgb, x, y, num_leaves=255, chunk=2, n_chunks=1,
                 tag=f"{n//1000}k/255leaf/strict", ds=ds2, split_batch=1,
                 learner=learner)
@@ -339,7 +360,7 @@ def child_extras() -> None:
                           cpu=cpu,
                           steps_per_tree=st2s[-1] if st2s else None,
                           batched_over_strict=round(
-                              ips2 / max(ips2s, 1e-9), 2))
+                              ips2 / max(ips2s, 1e-9), 2), **cst2s)
         except Exception as e:
             _record_point("higgs1m_255leaf_strict",
                           error=f"{type(e).__name__}: {e}"[:200])
@@ -413,11 +434,12 @@ def child_extras() -> None:
             x10[sl] += (rng.standard_normal(
                 (N_ROWS, N_FEAT)).astype(np.float32) * 1e-3)
         y10 = np.concatenate([y] * 10)
-        ips3, auc3, _, _ = _train_point(lgb, x10, y10, num_leaves=31,
-                                       chunk=8, n_chunks=2,
-                                       tag="10M/31leaf/sb8", split_batch=8)
+        ips3, auc3, _, _, cst3 = _train_point(lgb, x10, y10, num_leaves=31,
+                                              chunk=8, n_chunks=2,
+                                              tag="10M/31leaf/sb8",
+                                              split_batch=8)
         _record_point("higgs10m", value=round(ips3, 3),
-                      auc=round(float(auc3), 4))
+                      auc=round(float(auc3), 4), **cst3)
     except Exception as e:
         _record_point("higgs10m", error=f"{type(e).__name__}: {e}"[:200])
 
@@ -611,6 +633,9 @@ def main():
                 extra["higgs1m_31leaf_sb8_auc"] = p["auc"]
                 if p.get("steps_per_tree") is not None:
                     extra["higgs1m_31leaf_sb8_steps"] = p["steps_per_tree"]
+                for k_src in ("compile_s", "trace_count"):
+                    if p.get(k_src) is not None:
+                        extra[f"higgs1m_31leaf_sb8_{k_src}"] = p[k_src]
             continue
         if "value" not in p and "error" not in p:
             # keyed payload points (hist-bytes shapes, comm_bytes_per_iter
@@ -627,6 +652,11 @@ def main():
                                  ("batched_over_strict", "_speedup"),
                                  ("hist_tflops", "_hist_tflops"),
                                  ("mfu", "_mfu"),
+                                 # compile wall metrics (ROADMAP item 4):
+                                 # first-class in every train point
+                                 ("compile_s", "_compile_s"),
+                                 ("trace_count", "_trace_count"),
+                                 ("compile_cache_hits", "_cache_hits"),
                                  # reduced-shape CPU points must stay
                                  # distinguishable from full-size TPU
                                  # ones in the merged record

@@ -122,6 +122,12 @@ class Booster:
             raise ValueError("Booster needs train_set, model_file or model_str")
 
         self.config = Config(params or {})
+        # persistent-compile-cache bring-up + compile counters: every
+        # training Booster warm-starts its jit compiles from (and
+        # contributes to) the on-disk cache unless compile_cache=false;
+        # a pre-set JAX_COMPILATION_CACHE_DIR is respected
+        from .utils.compile_cache import maybe_enable_from_config
+        maybe_enable_from_config(self.config)
         # reference _update_params semantics (basic.py: train-time params
         # are update()d ONTO the dataset's own params): a not-yet-
         # constructed dataset bins with its OWN params as the base and
@@ -225,14 +231,21 @@ class Booster:
 
     # -- telemetry (obs/ subsystem; docs/Observability.md) ----------------
     def telemetry_snapshot(self) -> dict:
-        """Current metrics snapshot (deterministic dict; {} when
-        ``telemetry=false`` or this booster was loaded from a model
-        file).  Multi-process: per-shard registries are gathered and
+        """Current metrics snapshot (deterministic dict).  With
+        ``telemetry=false`` (the default) the obs metrics are absent but
+        the process-wide compile accounting is still included —
+        ``compile.count`` / ``compile.seconds`` (backend compiles),
+        ``compile.cache_hits`` / ``compile.cache_misses`` (persistent
+        cache), ``compile.traces`` (library jit traces) — so warm-start
+        is observable, not assumed (docs/Compile-Cache.md).
+        Multi-process: per-shard obs registries are gathered and
         merged, so every process sees host 0's aggregated view."""
         m = self._model
-        if m is None or getattr(m, "_obs", None) is None:
-            return {}
-        return m._obs.snapshot()
+        snap = {} if m is None or getattr(m, "_obs", None) is None \
+            else dict(m._obs.snapshot())
+        from .utils.compile_cache import compile_snapshot
+        snap.update(compile_snapshot())
+        return snap
 
     def telemetry_finish(self) -> dict:
         """Stop any active profiler window, flush the JSONL trace sink,
@@ -1118,6 +1131,10 @@ class Booster:
         params = {"objective": obj_kv.pop("objective", "regression")}
         params.update(obj_kv)
         self.config = Config(params)
+        # loaded boosters predict through jitted paths too (bucketed
+        # engine / serve): same cache bring-up as the training path
+        from .utils.compile_cache import maybe_enable_from_config
+        maybe_enable_from_config(self.config)
         self.objective = create_objective(self.config)
 
         body = "Tree=" + rest

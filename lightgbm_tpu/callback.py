@@ -102,7 +102,10 @@ def log_telemetry(period: int = 10, collect: Dict = None) -> Callable:
         for bi, bst in enumerate(boosters):
             snap_fn = getattr(bst, "telemetry_snapshot", None)
             snap = snap_fn() if snap_fn is not None else {}
-            if not snap:
+            if not snap or all(k.startswith("compile.") for k in snap):
+                # telemetry=false: the snapshot still carries the
+                # process-wide compile accounting (docs/Compile-Cache.md)
+                # but there is nothing iteration-scoped to log
                 continue
             if collect is not None:
                 if many:

@@ -210,6 +210,29 @@ _PARAMS: Dict[str, tuple] = {
     # different (still best-first) growth order.  0 = auto: 1 below 64
     # leaves, then 8.
     "split_batch": (int, 0, []),
+    # ---- compile cache / trace buckets ----
+    # compile-time management (ROADMAP item 4; docs/Compile-Cache.md)
+    # persistent XLA compilation cache across processes (train -> serve
+    # warm start): enabled by default; the directory precedence is
+    # compile_cache_dir > a pre-set JAX_COMPILATION_CACHE_DIR (the
+    # user's choice is respected, never clobbered) > a per-user,
+    # per-host-fingerprint tmp path (utils/compile_cache.py)
+    "compile_cache": (bool, True, ["persistent_compile_cache"]),
+    "compile_cache_dir": (str, "", []),
+    # persistence thresholds (previously hardwired): only compiles at
+    # least this long / this large are written to the cache
+    "compile_cache_min_compile_s": (float, 0.5, []),
+    "compile_cache_min_entry_bytes": (int, 0, []),
+    # bucket the trace-relevant static dims (utils/shapes.py): the
+    # grower's leaf budget pads to a pow2 bucket (num_leaves 31/40/63
+    # share ONE L=64 trace with bit-identical trees — the while_loop
+    # exits on the actual budget), explicit split_batch snaps to the
+    # shipped {1, 8, 16} widths, and DENSE validation sets row-bucket
+    # so early stopping over differently-sized valid sets stops
+    # re-tracing (sparse-binned valid sets keep exact shapes).
+    # false = exact per-shape traces (A/B escape hatch);
+    # tools/check_retraces.py pins the bucketed trace budget
+    "trace_buckets": (bool, True, []),
     # ---- telemetry / observability ----
     # master switch for the obs subsystem (lightgbm_tpu/obs/): per-phase
     # spans + metrics registry + comm-bytes counters on the training
@@ -535,6 +558,10 @@ class Config:
             raise ValueError(
                 f"finite_check_policy={self.finite_check_policy!r} must be "
                 "one of: raise, skip_iter, clamp")
+        if self.compile_cache_min_compile_s < 0:
+            raise ValueError("compile_cache_min_compile_s must be >= 0")
+        if self.compile_cache_min_entry_bytes < 0:
+            raise ValueError("compile_cache_min_entry_bytes must be >= 0")
         if self.telemetry_profile_iters is not None \
                 and len(self.telemetry_profile_iters) not in (1, 2):
             raise ValueError(

@@ -55,6 +55,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
             resume_req = bool(_coerce("resume", bool, params.pop(k)))
     cfg = Config(params)
     cfg.resume = resume_req
+    # persistent-compile-cache bring-up before any jax work (binning /
+    # init-score prediction may already trace): warm-starts every compile
+    # of this process from the on-disk cache (docs/Compile-Cache.md)
+    from .utils.compile_cache import maybe_enable_from_config
+    maybe_enable_from_config(cfg)
     from .config import canonical_params
     if "num_iterations" in canonical_params(params):
         # any num_iterations alias in params overrides the keyword

@@ -23,6 +23,8 @@ and serial_tree_learner.cpp:159-210): the whole tree build is ONE jitted
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
 from typing import Callable, NamedTuple, Optional
 
 import jax
@@ -34,6 +36,60 @@ from .ops.histogram import compute_histogram
 from . import sparse_data as _spd
 from .ops.split import (SplitParams, SplitResult, find_best_split,
                         leaf_output, monotone_penalty_factor)
+from .utils.compile_cache import trace_event
+
+
+def grower_trace_count() -> int:
+    """Number of times a grower program has been traced (== compiled,
+    modulo persistent-cache hits) in this process — the ``grower``
+    entry of ``utils/compile_cache.trace_counts()``, counted by the
+    ``trace_event`` call inside the traced function bodies (a Python
+    side effect: once per new jit cache entry, never per execution).
+    tests/test_compile_cache.py and tools/check_retraces.py read this
+    to prove the leaf-budget bucketing bounds XLA compiles (one L=64
+    trace covers num_leaves 31/40/63)."""
+    from .utils.compile_cache import trace_counts
+    return trace_counts().get("grower", 0)
+
+
+# process-level grower sharing: two Boosters whose grower CONFIG matches
+# (after leaf-budget bucketing the common num_leaves sweep collapses
+# onto one config) reuse the same jitted callable — and therefore the
+# same trace.  Keyed on every closure input of make_grower; skipped
+# whenever a distribution hook (an unkeyable callable) is present.
+# Bounded LRU: evicting an entry only drops the SHARED handle — live
+# Boosters keep their reference, exactly like the pre-memo behavior.
+_SHARED_GROWERS: "OrderedDict[tuple, Callable]" = OrderedDict()
+_SHARED_GROWERS_MAX = 64
+_SHARED_GROWERS_LOCK = threading.Lock()
+
+
+class _Unkeyable(Exception):
+    pass
+
+
+def _key_part(x):
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, (tuple, list)):
+        return tuple(_key_part(v) for v in x)
+    try:
+        a = np.asarray(x)
+    except Exception:
+        raise _Unkeyable
+    if a.dtype == object:
+        # np.asarray(<arbitrary object>).tobytes() is the raw CPython
+        # POINTER — address reuse after GC would alias two different
+        # configs onto one cached grower.  Unkeyable -> private jit.
+        raise _Unkeyable
+    return (str(a.dtype), a.shape, a.tobytes())
+
+
+def _grower_key(kw: dict):
+    try:
+        return tuple((k, _key_part(v)) for k, v in sorted(kw.items()))
+    except _Unkeyable:
+        return None
 
 
 class TreeArrays(NamedTuple):
@@ -128,9 +184,19 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 interaction_groups=None,
                 bynode_frac: float = 1.0, bynode_seed: int = 0,
                 cegb=None,
+                padded_leaves: Optional[int] = None,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
+
+    ``padded_leaves``: leaf-budget bucketing (utils/shapes.bucket_leaves)
+    — state arrays are sized to this PADDED budget while the grow loop
+    exits on the ACTUAL budget, which the caller must then pass per call
+    as the traced ``max_leaves`` scalar.  One padded trace covers every
+    ``num_leaves`` in its bucket (31/40/63 share L=64) with
+    bit-identical trees: padded leaf slots start at -inf cached gain so
+    argmax/top_k never select them, and the host side slices all tree
+    arrays by the returned ``num_leaves``.
 
     vals: [N, 3] f32 = (grad, hess, in-bag weight); out-of-bag rows zeroed.
 
@@ -215,7 +281,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       growth (between LightGBM's leaf-wise and XGBoost's depth-wise);
       K=1 keeps exact reference semantics and is the default.
     """
-    L = int(num_leaves)
+    L_req = int(num_leaves)
+    L = int(padded_leaves) if padded_leaves and int(padded_leaves) > L_req \
+        else L_req
+    padded = L != L_req
     B = int(num_bins)
     reduce_fn = hist_reduce or (lambda h: h)
     view_fn = hist_view or (lambda b: b)
@@ -529,7 +598,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
                   na_bin_part=None, is_cat=None,
                   rng_iter=None, cegb_used=None,
-                  num_bin_part=None) -> TreeArrays:
+                  num_bin_part=None, max_leaves=None) -> TreeArrays:
+        trace_event("grower")
+        if max_leaves is None:
+            if padded:
+                raise ValueError(
+                    "a leaf-padded grower needs the actual budget per "
+                    "call: pass max_leaves=<num_leaves>")
+            limit = jnp.int32(L)
+        else:
+            limit = jnp.asarray(max_leaves, jnp.int32)
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
         child_hist = _make_child_hist(n)
@@ -719,9 +797,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         # (no positive gain) exits instead of running no-op tail steps —
         # with 255-leaf budgets those dead steps used to dominate small
         # trees' device time (each one still copies the multi-MB carried
-        # state through the cond).
+        # state through the cond).  The exit bound is the TRACED actual
+        # budget ``limit`` (== L unless leaf-padded), which is what lets
+        # one padded trace serve a whole num_leaves bucket.
         st = lax.while_loop(
-            lambda s: (~s.done) & (s.num_leaves < L), split_step, st)
+            lambda s: (~s.done) & (s.num_leaves < limit), split_step, st)
         return TreeArrays(
             num_leaves=st.num_leaves,
             split_feature=st.split_feature,
@@ -743,12 +823,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             n_steps=st.num_leaves - 1,
         )
 
-    K = max(1, min(int(split_batch), L - 1)) if L > 1 else 1
+    # K clamps against the ACTUAL budget, not the padded one: the
+    # super-step width is baked into RNG streams (bynode/extra_trees key
+    # schedules) and tree shape, so padding must never change it
+    K = max(1, min(int(split_batch), L_req - 1)) if L_req > 1 else 1
 
     def grow_tree_batched(binned, vals, feature_mask, num_bin, na_bin,
                           na_bin_part=None, is_cat=None,
                           rng_iter=None, cegb_used=None,
-                          num_bin_part=None) -> TreeArrays:
+                          num_bin_part=None, max_leaves=None) -> TreeArrays:
         """K-splits-per-super-step grower (split_batch above).
 
         Per-leaf state arrays carry K scratch slots past the real range
@@ -756,6 +839,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         batch whose cached gain is non-positive (or past the leaf budget)
         are redirected there, so every step runs the same fixed-shape
         program and the scratch writes are sliced off at the end."""
+        trace_event("grower")
+        if max_leaves is None:
+            if padded:
+                raise ValueError(
+                    "a leaf-padded grower needs the actual budget per "
+                    "call: pass max_leaves=<num_leaves>")
+            limit = jnp.int32(L)
+        else:
+            limit = jnp.asarray(max_leaves, jnp.int32)
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
         if na_bin_part is None:
@@ -785,7 +877,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             s, st = carry
             gains, leaves = lax.top_k(lax.slice_in_dim(st.bg, 0, L), K)
             num_nodes = st.num_leaves - 1
-            budget = jnp.int32(L - 1) - num_nodes
+            budget = (limit - 1) - num_nodes
             # gains sorted desc and budget a prefix: valid slots are a
             # prefix, so node/leaf id assignment below stays contiguous
             valid = (gains > 0.0) & (kidx < budget) & (~st.done)
@@ -1000,9 +1092,10 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         # ~(L-1)(1-1/K) dead steps, each copying the multi-MB carried
         # state through the cond's no-op branch.  The loop exits the
         # moment the budget is exhausted or no leaf can split; the step
-        # counter ``s`` is carried for the bynode RNG stream.
+        # counter ``s`` is carried for the bynode RNG stream.  As in the
+        # strict grower, the bound is the TRACED actual budget.
         s_final, st = lax.while_loop(
-            lambda c: (~c[1].done) & (c[1].num_leaves < L), super_step,
+            lambda c: (~c[1].done) & (c[1].num_leaves < limit), super_step,
             (jnp.int32(0), st))
         return TreeArrays(
             num_leaves=st.num_leaves,
@@ -1026,4 +1119,36 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         )
 
     fn = grow_tree_batched if K > 1 else grow_tree
-    return jax.jit(fn) if jit else fn
+    if not jit:
+        return fn
+    # process-level sharing: identical configs (common after leaf-budget
+    # bucketing) reuse ONE jitted callable, so a num_leaves sweep inside
+    # a bucket traces the grower exactly once per process.  Distribution
+    # hooks are callables (unkeyable) -> those growers jit privately.
+    key = None
+    if all(h is None for h in (hist_reduce, hist_view, hist_expand,
+                               select_best, mono_view, count_reduce,
+                               sum_reduce)):
+        key = _grower_key(dict(
+            L=L, B=B, K=K, padded=padded, params=params,
+            max_depth=max_depth, block_rows=block_rows, subtract=subtract,
+            gather=gather, min_gather_rows=min_gather_rows, efb=efb,
+            gain_scale=gain_scale, extra_trees=extra_trees,
+            extra_seed=extra_seed, mono=mono, mono_penalty=mono_penalty,
+            interaction_groups=interaction_groups, bynode_frac=bynode_frac,
+            bynode_seed=bynode_seed, cegb=cegb,
+            # unpadded growers bake the budget as the default limit, so
+            # the key must carry it; padded ones take it per call
+            L_default=None if padded else L_req))
+    if key is None:
+        return jax.jit(fn)
+    with _SHARED_GROWERS_LOCK:
+        shared = _SHARED_GROWERS.get(key)
+        if shared is None:
+            shared = jax.jit(fn)
+            _SHARED_GROWERS[key] = shared
+            while len(_SHARED_GROWERS) > _SHARED_GROWERS_MAX:
+                _SHARED_GROWERS.popitem(last=False)
+        else:
+            _SHARED_GROWERS.move_to_end(key)
+    return shared

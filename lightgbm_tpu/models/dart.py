@@ -64,7 +64,9 @@ class DARTModel(GBDTModel):
             self._drop_contrib_train = contrib
             self._drop_contrib_valid = []
             for (vds, vbinned, _vs) in self.valid_sets:
-                vc = jnp.zeros((vds.num_data, self.num_class), jnp.float32)
+                # zeros_like: the valid score may carry row-bucket
+                # padding (gbdt.add_valid_set), so size off the score
+                vc = jnp.zeros_like(_vs)
                 for ti in self._drop_idx:
                     for k in range(self.num_class):
                         vc = vc.at[:, k].add(self._tree_contrib(vbinned, ti, k))

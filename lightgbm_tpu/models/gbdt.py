@@ -420,6 +420,39 @@ class GBDTModel:
                 "trees differ slightly from strict leaf-wise order — set "
                 "split_batch=1 for exact reference growth)")
 
+        # trace-relevant static dims are bucketed (utils/shapes.py) so a
+        # config sweep stays inside a bounded trace family; pinned by
+        # tools/check_retraces.py.  trace_buckets=false restores exact
+        # per-shape traces (A/B + escape hatch).
+        from ..utils.shapes import (SPLIT_BATCH_SET, bucket_leaves,
+                                    snap_split_batch)
+        self._trace_buckets = bool(getattr(config, "trace_buckets", True))
+        if self._trace_buckets and self._split_batch > 1 \
+                and self._split_batch not in SPLIT_BATCH_SET:
+            snapped = snap_split_batch(self._split_batch)
+            from ..utils.log import Log
+            Log.info(
+                f"split_batch={self._split_batch} snapped to the shipped "
+                f"super-step width {snapped} (trace_buckets=true pins the "
+                f"trace family to K in {SPLIT_BATCH_SET}; set "
+                "trace_buckets=false to keep an off-set width)")
+            self._split_batch = snapped
+        # leaf-budget bucketing: only the one-program (masked) growers
+        # take a traced budget — serial and tree_learner=data; the
+        # host-orchestrated partitioned learner and the voting/feature
+        # growers keep their exact shapes
+        self._leaf_pad = None
+        if self._trace_buckets and learner == "masked" \
+                and dist in (None, "data"):
+            lp = bucket_leaves(config.num_leaves)
+            # inflation cap: the grower carries a [L, F, B, 3] histogram
+            # per leaf slot, so padding a tiny budget to the 64 floor
+            # (e.g. num_leaves=4 -> 16x) could blow HBM on wide data;
+            # past 4x the trace consolidation isn't worth the state.
+            # The common sweep (31/40/63 -> 64) stays well inside.
+            if config.num_leaves < lp <= 4 * config.num_leaves:
+                self._leaf_pad = lp
+
         if dist == "data":
             from ..parallel.data_parallel import make_dp_grower
             self.grower = make_dp_grower(
@@ -431,6 +464,7 @@ class GBDTModel:
                 mono=self._mono if mono_masked_ok else None,
                 mono_penalty=config.monotone_penalty,
                 sparse=self._sparse,
+                padded_leaves=self._leaf_pad,
                 # owner-shard reduce-scatter (dp_owner_shard=false falls
                 # back to the full-psum reduction for A/B comparison)
                 owner_shard=config.dp_owner_shard)
@@ -488,7 +522,8 @@ class GBDTModel:
                 interaction_groups=inter,
                 bynode_frac=config.feature_fraction_bynode,
                 bynode_seed=config.feature_fraction_seed + 1,
-                cegb=self._cegb_state)
+                cegb=self._cegb_state,
+                padded_leaves=self._leaf_pad)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
             raise ValueError("linear_tree requires boosting=gbdt")
@@ -821,15 +856,31 @@ class GBDTModel:
     # -- plumbing ----------------------------------------------------------
     def add_valid_set(self, valid: Dataset) -> None:
         valid.construct(self.config)
+        nv = valid.num_data
+        pad = 0
         if valid.binned_sparse is not None:
             binned = valid.binned_sparse.to_device()
         else:
-            binned = jnp.asarray(valid.binned if self._use_efb
-                                 else valid.feature_binned())
-        init = np.zeros((valid.num_data, self.num_class), np.float32)
+            vb = valid.binned if self._use_efb else valid.feature_binned()
+            if self._trace_buckets and nv <= (1 << 20):
+                # row-bucket the valid set (utils/shapes.py pow2 policy)
+                # so the per-iteration score-update traversal — and
+                # therefore early stopping over differently-sized valid
+                # sets — traces once per BUCKET, not once per size.
+                # Padded rows are bin-0 and their scores are sliced off
+                # in valid_score(); metrics are byte-identical.  Above
+                # ~1M rows the up-to-2x recurring pad work outweighs the
+                # one-time retrace, so huge valid sets keep exact shapes.
+                from ..utils.shapes import bucket_rows
+                pad = bucket_rows(nv, min_bucket=256) - nv
+                if pad:
+                    vb = np.concatenate(
+                        [vb, np.zeros((pad, vb.shape[1]), vb.dtype)])
+            binned = jnp.asarray(vb)
+        init = np.zeros((nv + pad, self.num_class), np.float32)
         if valid.metadata.init_score is not None:
-            init += np.asarray(valid.metadata.init_score, np.float32) \
-                .reshape(valid.num_data, -1)
+            init[:nv] += np.asarray(valid.metadata.init_score, np.float32) \
+                .reshape(nv, -1)
         # models without device copies (reset_training_data installed an
         # existing ensemble): fold their contribution in by host
         # prediction on the raw values; device_trees always corresponds
@@ -843,8 +894,8 @@ class GBDTModel:
             raw = np.asarray(valid.raw_data, np.float64)
             for ti in range(n_host_only):
                 k = ti % self.num_class
-                init[:, k] += (self.tree_weights[ti]
-                               * self.models[ti].predict(raw))
+                init[:nv, k] += (self.tree_weights[ti]
+                                 * self.models[ti].predict(raw))
         score = jnp.asarray(init)
         # replay existing device trees (continued training)
         for ti, dt in enumerate(self.device_trees):
@@ -853,8 +904,10 @@ class GBDTModel:
             ht = self.models[mi] if mi < len(self.models) else None
             if ht is not None and ht.is_linear:
                 leaves = np.asarray(_tree_leaves(
-                    binned, dt, self.na_bin_dev, self.efb_maps))
+                    binned, dt, self.na_bin_dev, self.efb_maps))[:nv]
                 delta = self._linear_outputs(ht, leaves, valid.raw_data)
+                if pad:
+                    delta = np.pad(np.asarray(delta, np.float32), (0, pad))
                 score = score.at[:, k].add(
                     self.tree_weights[mi] * jnp.asarray(delta, jnp.float32))
             else:
@@ -1044,6 +1097,7 @@ class GBDTModel:
                 bynode_frac=cfg.feature_fraction_bynode,
                 bynode_seed=cfg.feature_fraction_seed + 1,
                 cegb=self._cegb_state,
+                padded_leaves=self._leaf_pad,
                 jit=False)
             obj = self.objective
             lr = jnp.float32(self.learning_rate)
@@ -1056,8 +1110,10 @@ class GBDTModel:
             use_cegb = self._cegb_state is not None
             nf = self.num_features
 
+            leaf_padded = self._leaf_pad is not None
+
             def one_iter(carry, xs):
-                score, dead, cuse = carry
+                score, dead, cuse, ml = carry
                 fmask, it = xs
                 g, h = obj.get_gradients(score[:, 0])
                 if fin_freq > 0 and fin_policy == "clamp":
@@ -1078,6 +1134,13 @@ class GBDTModel:
                     kw["rng_iter"] = it
                 if use_cegb:
                     kw["cegb_used"] = cuse
+                if leaf_padded:
+                    # the actual budget is a chunk ARGUMENT (not a baked
+                    # constant) so the fused-chunk HLO is identical
+                    # across a num_leaves bucket — in-process the chunk
+                    # still traces per booster, but the persistent cache
+                    # recognizes the compile
+                    kw["max_leaves"] = ml
                 arrays = grow(self.binned_dev, vals, fmask,
                               self._nb_grow, self._na_grow, **kw)
                 if use_cegb:
@@ -1142,12 +1205,12 @@ class GBDTModel:
                 # vector, ship shrunk leaf values
                 out = arrays._replace(leaf_of_row=jnp.zeros((), jnp.int32),
                                       leaf_value=lv)
-                return (score, dead, cuse), (out, bad)
+                return (score, dead, cuse, ml), (out, bad)
 
             @functools.partial(jax.jit, donate_argnums=(0,))
-            def chunk(score, fmasks, iters, cuse0):
-                (score, _, _), (out, bad) = jax.lax.scan(
-                    one_iter, (score, jnp.bool_(False), cuse0),
+            def chunk(score, fmasks, iters, cuse0, ml):
+                (score, _, _, _), (out, bad) = jax.lax.scan(
+                    one_iter, (score, jnp.bool_(False), cuse0, ml),
                     (fmasks, iters))
                 return score, out, bad
 
@@ -1199,7 +1262,8 @@ class GBDTModel:
             if self._cegb_state is not None \
             else jnp.zeros(1, bool)
         self.score, stacked, bad_flags = chunk(self.score, fmasks, iters,
-                                               cuse0)
+                                               cuse0,
+                                               jnp.int32(cfg.num_leaves))
         # the one sync per chunk (tree records + finite-guard flags)
         host, bad_host = jax.device_get((stacked, bad_flags))
         if obs is not None:
@@ -1235,7 +1299,7 @@ class GBDTModel:
                 self.models.append(ht)
                 dev_arrays = TreeArrays(*(fld[j] for fld in stacked))
                 self.device_trees.append(_DeviceTree(
-                    dev_arrays, jnp.zeros(cfg.num_leaves, jnp.float32), 1))
+                    dev_arrays, jnp.zeros_like(dev_arrays.leaf_value), 1))
                 self.tree_weights.append(1.0)
                 self.iter_ += 1
                 continue
@@ -1259,7 +1323,7 @@ class GBDTModel:
 
             dev_arrays = TreeArrays(*(fld[j] for fld in stacked))
             dev_lv = dev_arrays.leaf_value if nl > 1 else \
-                jnp.zeros(cfg.num_leaves, jnp.float32)
+                jnp.zeros_like(dev_arrays.leaf_value)
             steps = round_up_pow2(max(ht.max_depth(), 1))
             self.device_trees.append(_DeviceTree(dev_arrays, dev_lv, steps))
             self.tree_weights.append(1.0)
@@ -1382,6 +1446,11 @@ class GBDTModel:
                     # happen in-graph and are folded back below from the
                     # fetched split records
                     gkw["cegb_used"] = jnp.asarray(self._cegb_state.used)
+                if self._leaf_pad is not None:
+                    # leaf-padded trace: the ACTUAL budget rides in as a
+                    # traced scalar (the while_loop exit bound) so one
+                    # padded trace serves the whole num_leaves bucket
+                    gkw["max_leaves"] = jnp.int32(cfg.num_leaves)
             vals_g = self._prep_vals(vals)
             fmask_g = self._prep_fmask(fmask)
             if obs is not None:
@@ -1545,9 +1614,14 @@ class GBDTModel:
             for vi, (vds, vbinned, vscore) in enumerate(self.valid_sets):
                 if linear:
                     vleaves = np.asarray(_tree_leaves(
-                        vbinned, dt, self.na_bin_dev, self.efb_maps))
+                        vbinned, dt, self.na_bin_dev,
+                        self.efb_maps))[:vds.num_data]
                     vdelta = self._linear_outputs(ht, vleaves, vds.raw_data) \
                         - (init_scores[k] if init_scores[k] != 0.0 else 0.0)
+                    vdelta = np.asarray(vdelta, np.float32)
+                    if len(vscore) > vds.num_data:   # row-bucketed pad
+                        vdelta = np.pad(
+                            vdelta, (0, len(vscore) - vds.num_data))
                     vd = jnp.asarray(vdelta, jnp.float32)
                 else:
                     vd = _apply_tree(jnp.zeros_like(vscore[:, k]), vbinned,
@@ -1612,7 +1686,10 @@ class GBDTModel:
         return s
 
     def valid_score(self, i: int) -> np.ndarray:
-        s = np.asarray(self.valid_sets[i][2])
+        vds = self.valid_sets[i][0]
+        # slice off the row-bucket padding (add_valid_set) before any
+        # metric/consumer sees the scores
+        s = np.asarray(self.valid_sets[i][2])[:vds.num_data]
         if self.config.boosting == "rf" and self.iter_ > 0:
             s = s / self.iter_
         return s

@@ -111,7 +111,8 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    block_rows: int = 0, axis: str = "data", efb=None,
                    split_batch: int = 1, mono=None,
                    mono_penalty: float = 0.0, sparse: bool = False,
-                   owner_shard: bool = True):
+                   owner_shard: bool = True,
+                   padded_leaves=None):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
     Inputs: binned [N, F] (or the bundled [N, G] group matrix when ``efb``
@@ -128,7 +129,8 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
     kw = dict(num_leaves=num_leaves, num_bins=num_bins, params=params,
               max_depth=max_depth, block_rows=block_rows, axis=axis,
               efb=efb, split_batch=split_batch, mono=mono,
-              mono_penalty=mono_penalty, sparse=sparse)
+              mono_penalty=mono_penalty, sparse=sparse,
+              padded_leaves=padded_leaves)
     inner = _make_dp_owner_grower(mesh, **kw) if owner_shard \
         else _make_dp_psum_grower(mesh, **kw)
 
@@ -157,7 +159,7 @@ class _CollectiveGate:
 
 def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                           max_depth, block_rows, axis, efb, split_batch,
-                          mono, mono_penalty, sparse):
+                          mono, mono_penalty, sparse, padded_leaves=None):
     """Owner-shard data-parallel grower (see module docstring)."""
     n_shards = mesh.shape[axis]
     out_specs = _dp_out_specs(axis)
@@ -225,7 +227,8 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
             hist_expand=hist_expand, select_best=select_best,
             efb=efb, split_batch=split_batch, mono=mono,
             mono_view=None if mono is None else mono_view,
-            mono_penalty=mono_penalty, jit=False)
+            mono_penalty=mono_penalty, padded_leaves=padded_leaves,
+            jit=False)
 
         def _localize(fmask, nb, na, ic):
             """Scan-space metadata slices for this shard's owned
@@ -242,30 +245,32 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
             from ..sparse_data import SparseBinned
             stride, nfs = sparse_key
 
-            def wrapped(flat, db, vals, fmask, nb, na, nabp, ic):
+            def wrapped(flat, db, vals, fmask, nb, na, nabp, ic, ml):
                 fm_l, nb_l, na_l, ic_l = _localize(fmask, nb, na, ic)
                 return inner(SparseBinned(flat, db, stride, nfs), vals,
                              fm_l, nb_l, na_l, nabp, ic_l,
-                             num_bin_part=nb)
+                             num_bin_part=nb, max_leaves=ml)
 
             in_specs = (P(axis, None), P(None), P(axis, None),
-                        P(), P(), P(), P(), P())
+                        P(), P(), P(), P(), P(), P())
         else:
-            def wrapped(binned, vals, fmask, nb, na, nabp, ic):
+            def wrapped(binned, vals, fmask, nb, na, nabp, ic, ml):
                 fm_l, nb_l, na_l, ic_l = _localize(fmask, nb, na, ic)
                 return inner(binned, vals, fm_l, nb_l, na_l, nabp, ic_l,
-                             num_bin_part=nb)
+                             num_bin_part=nb, max_leaves=ml)
 
             in_specs = (P(axis, None), P(axis, None),
-                        P(), P(), P(), P(), P())
+                        P(), P(), P(), P(), P(), P())
 
         fn = jax.jit(shard_map(wrapped, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False))
         return fn, plan
 
-    def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
+    def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None,
+             max_leaves=None):
         if is_cat is None:
             is_cat = jnp.zeros(num_bin.shape[0], bool)
+        ml = jnp.int32(num_leaves if max_leaves is None else max_leaves)
         nf = int(num_bin.shape[0])
         if sparse:
             key = (nf, binned.stride, binned.num_features)
@@ -275,13 +280,13 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
             fn, plan = cache[key]
             grow.plan = plan
             return fn(binned.flat, binned.default_bin, vals, feature_mask,
-                      num_bin, na_bin, na_bin, is_cat)
+                      num_bin, na_bin, na_bin, is_cat, ml)
         if nf not in cache:
             cache[nf] = _build(nf)
         fn, plan = cache[nf]
         grow.plan = plan
         return fn(binned, vals, feature_mask, num_bin, na_bin, na_bin,
-                  is_cat)
+                  is_cat, ml)
 
     grow.owner_shard = True
     grow.comm = ledger
@@ -293,7 +298,7 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
 
 def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                          max_depth, block_rows, axis, efb, split_batch,
-                         mono, mono_penalty, sparse):
+                         mono, mono_penalty, sparse, padded_leaves=None):
     """Legacy full-psum data-parallel grower: every shard receives ALL
     global histograms and recomputes the split decision replicated (no
     separate best-split sync needed — but per-chip histogram state scales
@@ -307,7 +312,7 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                                          cadence="tree"),
         efb=efb,
         split_batch=split_batch, mono=mono, mono_penalty=mono_penalty,
-        jit=False)
+        padded_leaves=padded_leaves, jit=False)
 
     out_specs = _dp_out_specs(axis)
 
@@ -322,41 +327,51 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
         cache = {}
 
         def _sparse_fn(stride: int, nf: int):
-            def wrapped(flat, db, vals, fm, nb, nab, nabp, ic):
+            def wrapped(flat, db, vals, fm, nb, nab, nabp, ic, ml):
                 return inner(SparseBinned(flat, db, stride, nf), vals,
-                             fm, nb, nab, nabp, ic)
+                             fm, nb, nab, nabp, ic, max_leaves=ml)
             return shard_map(
                 wrapped, mesh=mesh,
                 in_specs=(P(axis, None), P(None), P(axis, None),
-                          P(), P(), P(), P(), P()),
+                          P(), P(), P(), P(), P(), P()),
                 out_specs=out_specs, check_vma=False)
 
-        def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
+        def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None,
+                 max_leaves=None):
             if is_cat is None:
                 is_cat = jnp.zeros(num_bin.shape[0], bool)
+            ml = jnp.int32(num_leaves if max_leaves is None else max_leaves)
             key = (binned.stride, binned.num_features)
             if key not in cache:
                 cache[key] = jax.jit(_sparse_fn(*key))
             return cache[key](binned.flat, binned.default_bin, vals,
                               feature_mask, num_bin, na_bin, na_bin,
-                              is_cat)
+                              is_cat, ml)
 
         grow.owner_shard = False
         grow.comm = ledger
         return grow
 
+    def _dense(b, v, fm, nb, na, ic, ml):
+        # na doubles as na_bin_part (the old outside-the-shard_map
+        # duplication, folded in), so _dense has 7 params — in_specs
+        # must match that arity, not inner's
+        return inner(b, v, fm, nb, na, na, ic, max_leaves=ml)
+
     f = shard_map(
-        inner, mesh=mesh,
+        _dense, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
         out_specs=out_specs, check_vma=False)
 
-    jitted = jax.jit(
-        lambda b, v, fm, nb, na, ic: f(b, v, fm, nb, na, na, ic))
+    jitted = jax.jit(f)
 
-    def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None):
+    def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None,
+             max_leaves=None):
         if is_cat is None:
             is_cat = jnp.zeros(num_bin.shape[0], bool)
-        return jitted(binned, vals, feature_mask, num_bin, na_bin, is_cat)
+        ml = jnp.int32(num_leaves if max_leaves is None else max_leaves)
+        return jitted(binned, vals, feature_mask, num_bin, na_bin, is_cat,
+                      ml)
 
     grow.owner_shard = False
     grow.comm = ledger

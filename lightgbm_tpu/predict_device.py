@@ -19,6 +19,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .utils.compile_cache import trace_event
+from .utils.shapes import round_up_pow2  # noqa: F401  (shared policy;
+#                                          re-exported for existing users)
+
 
 @functools.partial(jax.jit, static_argnames=("steps",))
 def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
@@ -29,6 +33,7 @@ def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
     ``efb_maps``: optional (group_of_feat, off_of_feat, nbm1_of_feat) device
     arrays when ``binned`` is the EFB-grouped matrix [N, G] (efb.py) — the
     gathered group bin is unmapped to the feature's own bin space."""
+    trace_event("traverse_tree")
     n = binned.shape[0]
     node = jnp.zeros(n, jnp.int32)
 
@@ -63,6 +68,7 @@ def add_tree_score(score, binned, split_feature, threshold_bin, default_left,
                    left_child, right_child, na_bin, is_cat_node, cat_rank,
                    leaf_value, weight, efb_maps=None, *, steps: int):
     """score += weight * tree(binned) — incremental ScoreUpdater step."""
+    trace_event("add_tree_score")
     leaf = traverse_tree_binned(binned, split_feature, threshold_bin,
                                 default_left, left_child, right_child,
                                 na_bin, is_cat_node, cat_rank, efb_maps,
@@ -70,12 +76,9 @@ def add_tree_score(score, binned, split_feature, threshold_bin, default_left,
     return score + weight * jnp.take(leaf_value, leaf)
 
 
-def round_up_pow2(x: int) -> int:
-    """Bucket traversal depth to limit jit-cache entries."""
-    p = 1
-    while p < x:
-        p *= 2
-    return p
+# (round_up_pow2 moved to utils/shapes.py — the ONE bucketing policy
+# shared by serving batches, validation rows and the grower leaf budget
+# — and re-imported above so existing callers keep working.)
 
 
 # ---------------------------------------------------------------------------
@@ -113,6 +116,7 @@ def traverse_forest_binned(binned, split_feature, threshold_bin,
     a module-level trace counter records each compilation.
     """
     _FOREST_TRACES[0] += 1
+    trace_event("forest")
     n = binned.shape[0]
     t = split_feature.shape[0]
     node = jnp.zeros((n, t), jnp.int32)

@@ -42,7 +42,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..predict_device import round_up_pow2
+from ..utils.shapes import bucket_rows, round_up_pow2
 
 _CAT_BIT = 1
 _DEFAULT_LEFT_BIT = 2
@@ -302,10 +302,11 @@ class PredictorEngine:
         return out
 
     def _bucket(self, n: int) -> int:
-        b = max(self.min_bucket, round_up_pow2(max(n, 1)))
-        if self.max_batch is not None:
-            b = min(b, round_up_pow2(self.max_batch))
-        return b
+        # the ONE shared bucketing policy (utils/shapes.py) — the same
+        # pow2-with-floor rule now also buckets validation-set rows and
+        # (via bucket_leaves) the grower's leaf budget
+        return bucket_rows(n, min_bucket=self.min_bucket,
+                           cap=self.max_batch)
 
     def _device_bin_tables(self):
         import jax.numpy as jnp

@@ -67,6 +67,11 @@ class Server:
         self.config = params if isinstance(params, Config) \
             else Config(params or {})
         cfg = self.config
+        # serve bring-up shares the training processes' persistent
+        # compile cache (train -> serve warm start) and installs the
+        # compile counters surfaced by /metrics
+        from ..utils.compile_cache import maybe_enable_from_config
+        maybe_enable_from_config(cfg)
         from ..obs import MetricsRegistry, maybe_session
         self.obs = maybe_session(cfg)
         self.metrics = self.obs.metrics if self.obs is not None \
@@ -246,6 +251,11 @@ class Server:
                 snap["serve.engine"] = engine.compile_stats()
         except NoModelError:
             pass
+        # process-wide compile accounting (utils/compile_cache.py): the
+        # serving replica's warm-start evidence — backend compiles,
+        # persistent-cache hits/misses, and per-program trace counts
+        from ..utils.compile_cache import compile_snapshot
+        snap.update(compile_snapshot(traces="by_name"))
         return snap
 
     def close(self) -> None:

@@ -1,15 +1,34 @@
-"""Machine-keyed persistent XLA compilation cache.
+"""Persistent XLA compilation cache + process-wide compile accounting.
 
-One call makes every jit compile in this process reusable by later
-processes on the SAME host: the cache directory is keyed by the host's
-CPU feature fingerprint because XLA:CPU AOT entries are
-machine-specific and this can run in environments that migrate between
-heterogeneous hosts — a cache written on one host fails every load on
-another ("Target machine feature ... is not supported"), costing the
-failed loads on top of the recompiles (measured: 25 cold minutes for
-the test suite).  Used by tests/conftest.py, the spawned multi-process
-pod workers, and ``lightgbm_tpu.distributed`` worker bootstrap — pod
-tests pay dozens of fresh-process compiles per run without it.
+Two concerns live here because they are two halves of one feature —
+making compile time a managed, *measured* resource (ROADMAP item 4:
+BENCH_r02 paid 73.4 s of compile before the first iteration vs 84 s of
+steady state for 99 iterations):
+
+1. :func:`enable_persistent_cache` points jax at an on-disk compilation
+   cache so later processes on the same host warm-start every compile
+   (train -> serve included).  The cache directory is keyed by the
+   host's CPU feature fingerprint because XLA:CPU AOT entries are
+   machine-specific and this can run in environments that migrate
+   between heterogeneous hosts — a cache written on one host fails
+   every load on another ("Target machine feature ... is not
+   supported"), costing the failed loads on top of the recompiles
+   (measured: 25 cold minutes for the test suite).  A user's pre-set
+   ``JAX_COMPILATION_CACHE_DIR`` (or an explicit ``compile_cache_dir``
+   param) is RESPECTED, never clobbered.  Config wiring:
+   ``compile_cache`` / ``compile_cache_dir`` /
+   ``compile_cache_min_compile_s`` / ``compile_cache_min_entry_bytes``
+   (engine.train / Booster / cli / serve bring-up via
+   :func:`maybe_enable_from_config`).
+
+2. :func:`install_compile_counters` + :func:`trace_event` make
+   warm-start observable instead of assumed: process-global counters of
+   backend compiles / persistent-cache hits+misses / compile seconds
+   (fed by ``jax.monitoring``), and named trace counters bumped at
+   trace time by the library's jitted entry points (grower, fused
+   chunk, traversal, forest).  Surfaced through
+   ``Booster.telemetry_snapshot()``, the serve ``/metrics`` endpoint,
+   ``bench.py`` records, and pinned by tools/check_retraces.py.
 """
 
 from __future__ import annotations
@@ -18,6 +37,8 @@ import getpass
 import hashlib
 import os
 import tempfile
+import threading
+from typing import Dict, Optional
 
 
 def machine_tag() -> str:
@@ -32,24 +53,197 @@ def machine_tag() -> str:
     return hashlib.sha256(platform.processor().encode()).hexdigest()[:10]
 
 
-def enable_persistent_cache(min_compile_secs: float = 0.5) -> str:
-    """Point jax at the per-host cache dir; returns the path."""
-    import jax
-    path = os.path.join(
+def default_cache_dir() -> str:
+    """The per-user, per-host-fingerprint cache path used when neither
+    the caller nor the environment chose one."""
+    return os.path.join(
         tempfile.gettempdir(),
         f"lgbtpu_jax_cache_{getpass.getuser()}_{machine_tag()}")
+
+
+def configured_cache_dir():
+    """The cache dir jax is ALREADY configured with (from a previous
+    enable, a user's ``jax.config.update``, or the
+    ``JAX_COMPILATION_CACHE_DIR`` env var), or None."""
+    try:
+        import jax
+        d = jax.config.jax_compilation_cache_dir
+    except Exception:
+        d = None
+    return d or os.environ.get("JAX_COMPILATION_CACHE_DIR") or None
+
+
+def enable_persistent_cache(min_compile_secs: float = 0.5,
+                            cache_dir: Optional[str] = None,
+                            min_entry_bytes: int = 0) -> str:
+    """Enable the persistent compilation cache; returns the path used.
+
+    Precedence for the directory: explicit ``cache_dir`` argument >
+    an already-configured dir (jax config or the
+    ``JAX_COMPILATION_CACHE_DIR`` env var — a user's choice is
+    respected, not clobbered) > the per-host default.  The persistence
+    thresholds are parameters (they used to be hardwired to
+    ``min_entry_size=0``, silently overriding a user's tuning), and a
+    threshold pinned via its jax env var
+    (``JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS`` /
+    ``JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES``) is likewise left
+    alone."""
+    import jax
+    path = cache_dir or configured_cache_dir() or default_cache_dir()
     jax.config.update("jax_compilation_cache_dir", path)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                      min_compile_secs)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    if "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES" not in os.environ:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          int(min_entry_bytes))
+    install_compile_counters()
     return path
+
+
+def maybe_enable_from_config(config) -> Optional[str]:
+    """Config-driven bring-up used by Booster / engine.train / cli /
+    serve: enables the persistent cache when ``compile_cache`` is on
+    (the default) and always installs the compile counters so
+    ``compile.*`` telemetry works even with the cache disabled.
+    Idempotent and cheap; returns the cache path or None."""
+    install_compile_counters()
+    if not getattr(config, "compile_cache", True):
+        return None
+    return enable_persistent_cache(
+        min_compile_secs=getattr(config, "compile_cache_min_compile_s",
+                                 0.5),
+        cache_dir=getattr(config, "compile_cache_dir", "") or None,
+        min_entry_bytes=getattr(config, "compile_cache_min_entry_bytes",
+                                0))
+
+
+# ---------------------------------------------------------------------------
+# Process-wide compile accounting
+# ---------------------------------------------------------------------------
+
+# jax.monitoring event names this build of jax emits (jax 0.4.x:
+# jax/_src/dispatch.py BACKEND_COMPILE_EVENT, jax/_src/compiler.py /
+# compilation_cache.py cache hit/miss record_event calls).  Matched by
+# substring so a renamed prefix degrades to "not counted", never to a
+# crash.
+_BACKEND_COMPILE = "backend_compile"
+_CACHE_HIT = "cache_hits"
+_CACHE_MISS = "cache_misses"
+
+_STATS_LOCK = threading.Lock()
+_COMPILE_STATS = {"count": 0, "seconds": 0.0,
+                  "cache_hits": 0, "cache_misses": 0}
+_COUNTERS_INSTALLED = [False]
+
+
+def install_compile_counters() -> bool:
+    """Register the process-global jax.monitoring listeners feeding
+    :func:`compile_stats`.  Listeners cannot be unregistered, so this
+    installs exactly once; returns False when the monitoring surface is
+    unavailable."""
+    if _COUNTERS_INSTALLED[0]:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        if _BACKEND_COMPILE in event:
+            with _STATS_LOCK:
+                _COMPILE_STATS["count"] += 1
+                _COMPILE_STATS["seconds"] += float(duration)
+
+    def _on_event(event: str, **kw) -> None:
+        if _CACHE_HIT in event:
+            with _STATS_LOCK:
+                _COMPILE_STATS["cache_hits"] += 1
+        elif _CACHE_MISS in event:
+            with _STATS_LOCK:
+                _COMPILE_STATS["cache_misses"] += 1
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        return False
+    _COUNTERS_INSTALLED[0] = True
+    return True
+
+
+def compile_stats() -> Dict[str, float]:
+    """Snapshot of process-wide compile accounting: backend compile
+    REQUESTS (count/seconds — jax emits the duration event on
+    persistent-cache hits too, just with the near-zero load time) and
+    persistent-cache hits/misses (``cache_misses`` is the
+    fresh-compile count).  Zeros until
+    :func:`install_compile_counters` ran (Booster/serve bring-up
+    installs it)."""
+    with _STATS_LOCK:
+        return dict(_COMPILE_STATS)
+
+
+# ---------------------------------------------------------------------------
+# Named trace counters (retrace-budget lint)
+# ---------------------------------------------------------------------------
+
+_TRACE_COUNTS: Dict[str, int] = {}
+_TRACE_PREFIX = "/lgbtpu/trace/"
+
+
+def trace_event(name: str) -> None:
+    """Record one TRACE of a named jitted program.  Called as a Python
+    side effect from inside the traced function body, so it fires once
+    per fresh jit cache entry and never per execution.  Mirrored into
+    ``jax.monitoring`` under ``/lgbtpu/trace/<name>`` so external
+    listeners (tools/check_retraces.py) can count without importing
+    library internals."""
+    with _STATS_LOCK:
+        _TRACE_COUNTS[name] = _TRACE_COUNTS.get(name, 0) + 1
+    try:
+        from jax import monitoring
+        monitoring.record_event(_TRACE_PREFIX + name)
+    except Exception:
+        pass
+
+
+def trace_counts() -> Dict[str, int]:
+    """Per-name trace counters for this process (deterministic: traces
+    are independent of the persistent cache's disk state — a cache hit
+    skips the COMPILE, never the trace)."""
+    with _STATS_LOCK:
+        return dict(_TRACE_COUNTS)
+
+
+def trace_total() -> int:
+    with _STATS_LOCK:
+        return sum(_TRACE_COUNTS.values())
+
+
+def compile_snapshot(traces: str = "total") -> Dict[str, object]:
+    """The ``compile.*`` key block shared by every telemetry surface
+    (``Booster.telemetry_snapshot`` and the serve ``/metrics``
+    snapshot): compile requests, persistent-cache hits/misses, and the
+    library trace counters — as a total (``traces="total"``) or the
+    per-program breakdown (``traces="by_name"``)."""
+    cs = compile_stats()
+    return {
+        "compile.count": cs["count"],
+        "compile.seconds": cs["seconds"],
+        "compile.cache_hits": cs["cache_hits"],
+        "compile.cache_misses": cs["cache_misses"],
+        "compile.traces": (trace_counts() if traces == "by_name"
+                           else trace_total()),
+    }
 
 
 def watch_compiles(metrics, tracer=None) -> bool:
     """Feed XLA compile / compilation-cache events into an obs
     MetricsRegistry (+ optional Tracer instants): compile durations as
     a ``jax.compile_seconds`` histogram, cache hits/misses and other
-    compile-adjacent counters as ``jax.events{event=...}``.
+    compile-adjacent counters as ``jax.events{event=...}``, and the
+    library's own trace events as ``jax.traces{name=...}``.
 
     Uses ``jax.monitoring``'s public listener hooks; listeners are
     process-global and cannot be unregistered, so the registered
@@ -70,6 +264,10 @@ def watch_compiles(metrics, tracer=None) -> bool:
             tracer.instant("jax_compile", event=event, seconds=duration)
 
     def _on_event(event: str, **kw) -> None:
+        if event.startswith(_TRACE_PREFIX):
+            metrics.counter("jax.traces",
+                            name=event[len(_TRACE_PREFIX):]).inc()
+            return
         if "compil" not in event and "cache" not in event:
             return
         metrics.counter("jax.events", event=event).inc()

@@ -1,0 +1,79 @@
+"""Shared shape-bucketing policy for trace-relevant static dimensions.
+
+Every distinct static shape that reaches a jitted program is a fresh
+XLA trace + compile — BENCH_r02 measured 73 s of compile before the
+first training iteration, rivaling 99 iterations of steady state
+(ROADMAP item 4).  ``serve/engine.py`` already proved the fix for the
+serving batch axis: round the dimension up to a power-of-two bucket so
+one trace covers a family of sizes.  This module is that policy
+extracted so every layer buckets the same way:
+
+- **rows** (serve batches, validation sets): power-of-two with a floor,
+  so tiny sizes share one shape instead of one per pow2 below it
+  (:func:`bucket_rows`).
+- **leaf budget** (the grower's ``num_leaves``): power-of-two with a
+  floor of ``LEAF_BUCKET_FLOOR`` — the grower's ``lax.while_loop``
+  exits on the *actual* budget (a traced scalar), so ``num_leaves``
+  31 / 40 / 63 all run the same ``L=64``-shaped program with
+  bit-identical output (:func:`bucket_leaves`, grower.py).
+- **split_batch**: pinned to the shipped ``{1, 8, 16}`` set
+  (:func:`snap_split_batch`) — the auto-tuner only ever picks from it,
+  and snapping explicit odd values keeps the super-step trace family
+  closed (K is a structural constant of the trace, it cannot be made
+  dynamic the way the leaf budget can).
+
+The retrace-budget lint (tools/check_retraces.py) pins the trace
+counts this policy produces; changing a bucket boundary is a conscious
+act that updates tools/retrace_budget.txt.
+"""
+
+from __future__ import annotations
+
+# floor of the leaf-budget bucket: the common LightGBM budgets 31..63
+# (default 31) collapse onto one L=64 trace; 127 -> 128, 255 -> 256.
+# Below the floor the padded state costs (hist [L, F, B, 3] carry) stay
+# small in absolute terms while the trace family shrinks drastically.
+LEAF_BUCKET_FLOOR = 64
+
+# the shipped split_batch widths (grower super-step K): 1 = strict
+# leaf-wise reference growth, 8/16 = the measured MXU-sublane sweet
+# spots (PROFILE.md §2-6; models/gbdt.py auto-selection)
+SPLIT_BATCH_SET = (1, 8, 16)
+
+
+def round_up_pow2(x: int) -> int:
+    """Smallest power of two >= x (>= 1)."""
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
+def bucket_rows(n: int, min_bucket: int = 16, cap: int | None = None) -> int:
+    """Pow2 row bucket with a floor (and an optional pow2'd cap) —
+    the serve/engine.py batch policy, shared."""
+    b = max(int(min_bucket), round_up_pow2(max(int(n), 1)))
+    if cap is not None:
+        b = min(b, round_up_pow2(int(cap)))
+    return b
+
+
+def bucket_leaves(num_leaves: int, floor: int = LEAF_BUCKET_FLOOR) -> int:
+    """Padded leaf budget covering ``num_leaves``: pow2 with a floor.
+
+    31 / 40 / 63 -> 64; 127 -> 128; 255 -> 256.  The grower exits its
+    while_loop on the ACTUAL budget, so the padded slots only cost
+    state memory, never semantics (grower.py ``max_leaves``)."""
+    return max(int(floor), round_up_pow2(int(num_leaves)))
+
+
+def snap_split_batch(k: int) -> int:
+    """Nearest shipped super-step width >= the request (capped at the
+    largest shipped width); 0/1 pass through untouched."""
+    k = int(k)
+    if k <= 1:
+        return k
+    for s in SPLIT_BATCH_SET:
+        if k <= s:
+            return s
+    return SPLIT_BATCH_SET[-1]

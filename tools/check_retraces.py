@@ -1,0 +1,255 @@
+"""Retrace-budget lint: pin the number of jit traces for a canonical
+config matrix so retrace regressions fail CI instead of silently
+costing 73 s of compile on device.
+
+The sibling of tools/check_syncs.py for the OTHER silent perf tax:
+BENCH_r02 paid 73.4 s of XLA trace+compile before the first training
+iteration vs 84 s of steady state for 99 iterations (ROADMAP item 4).
+The shape-bucketing layer (utils/shapes.py: leaf-budget padding,
+pinned split_batch widths, row-bucketed valid sets, pow2 serve
+batches) bounds the trace family; this lint keeps that bound true
+structurally:
+
+- every library jit entry point records a ``jax.monitoring`` event
+  (``/lgbtpu/trace/<name>``, utils/compile_cache.trace_event) at TRACE
+  time — cache-state-independent, so the counts are deterministic for
+  a fixed code + config matrix;
+- the canonical matrix below (leaf-budget sweep, bagging/GOSS
+  sampling, two valid-set sizes, fused chunks, serve batch mix) runs
+  on CPU and the per-scenario counts must EXACTLY match
+  ``tools/retrace_budget.txt``;
+- entries in the budget file that the matrix no longer produces are
+  reported as stale, so the file cannot rot;
+- a deliberately unbucketed negative control (``trace_buckets=false``
+  leaf sweep) must EXCEED the bucketed budget — proving the lint
+  would catch a bucketing regression, not just rubber-stamp it.
+
+Run standalone (``python tools/check_retraces.py``; exit 1 on
+findings; ``--update`` rewrites the budget file) or via tier-1
+(tests/test_zretrace.py::TestRetraceLint runs it in a fresh
+subprocess).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET = os.path.join(REPO, "tools", "retrace_budget.txt")
+sys.path.insert(0, REPO)
+
+_TRACE_PREFIX = "/lgbtpu/trace/"
+
+# live monitoring-counted totals (event name -> count)
+_counts: Dict[str, int] = {}
+
+
+def _install_listener() -> None:
+    from jax import monitoring
+
+    def _on_event(event: str, **kw) -> None:
+        if event.startswith(_TRACE_PREFIX):
+            name = event[len(_TRACE_PREFIX):]
+            _counts[name] = _counts.get(name, 0) + 1
+
+    monitoring.register_event_listener(_on_event)
+
+
+class _Scope:
+    """Delta of the monitoring-counted traces over a scenario."""
+
+    def __init__(self, scenario: str, into: Dict[str, int]):
+        self.scenario = scenario
+        self.into = into
+
+    def __enter__(self):
+        self.t0 = dict(_counts)
+        return self
+
+    def __exit__(self, *exc):
+        for name, v in _counts.items():
+            d = v - self.t0.get(name, 0)
+            if d:
+                self.into[f"{self.scenario}.{name}"] = \
+                    self.into.get(f"{self.scenario}.{name}", 0) + d
+        return False
+
+
+def _data(n: int = 600, f: int = 12, seed: int = 0):
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, f)
+    y = (x[:, 0] * 1.5 - x[:, 1] + 0.3 * rs.randn(n) > 0)
+    return x, y.astype("float32")
+
+
+def _base_params(**over):
+    p = {"objective": "binary", "verbosity": 0, "min_data_in_leaf": 5,
+         "max_bin": 31, "tpu_learner": "masked", "fused_chunk": 0,
+         "num_leaves": 40}
+    p.update(over)
+    return p
+
+
+def _train(lgb, x, y, rounds: int = 2, valid=None, **over):
+    p = _base_params(**over)
+    ds = lgb.Dataset(x, label=y, params=p)
+    vs = None
+    if valid:
+        vs = [lgb.Dataset(vx, label=vy, params=p, reference=ds)
+              for vx, vy in valid]
+    return lgb.train(p, ds, num_boost_round=rounds, valid_sets=vs)
+
+
+def run_matrix() -> Dict[str, int]:
+    """Run the canonical scenarios; returns {scenario.counter: traces}."""
+    import lightgbm_tpu as lgb
+    measured: Dict[str, int] = {}
+    x, y = _data()
+
+    # 1. leaf-budget sweep: 31/40/63 bucket onto ONE L=64 grower trace
+    #    (the headline of the bucketing layer)
+    with _Scope("leaf_sweep", measured):
+        for nl in (31, 40, 63):
+            _train(lgb, x, y, num_leaves=nl)
+
+    # 2. sampling variants re-use the sweep's trace: bagging and GOSS
+    #    change VALUES (the in-bag weight column), never shapes, and
+    #    the process-level grower memo must recognize the config
+    with _Scope("sampling", measured):
+        _train(lgb, x, y, bagging_fraction=0.7, bagging_freq=1)
+        _train(lgb, x, y, data_sample_strategy="goss")
+
+    # 3. two valid-set sizes row-bucket onto one traversal shape, so
+    #    early stopping over mixed valid sets stops re-tracing
+    with _Scope("valid_sizes", measured):
+        _train(lgb, x, y, rounds=3, num_leaves=15,
+               valid=[(x[:200], y[:200]), (x[200:430], y[200:430])],
+               metric=["binary_logloss"])
+
+    # 4. fused chunks: one chunk trace per booster today (the chunk
+    #    closes over the objective), but the leaf budget rides as an
+    #    argument so the HLO — and the persistent-cache key — is shared
+    #    across the bucket
+    with _Scope("fused", measured):
+        for nl in (31, 40):
+            _train(lgb, x, y, num_leaves=nl, fused_chunk=2)
+
+    # 5. serve batch mix: pow2-bucketed engine bounds forest traces
+    with _Scope("serve_buckets", measured):
+        from lightgbm_tpu.serve.engine import PredictorEngine
+        bst = _train(lgb, x, y)
+        eng = PredictorEngine.from_booster(bst, max_batch=64)
+        for n in (3, 5, 17, 30, 64, 100):
+            eng.predict(x[:n])
+
+    # negative control: the SAME sweep unbucketed must blow the budget
+    with _Scope("negative_unbucketed", measured):
+        for nl in (31, 40, 63):
+            _train(lgb, x, y, num_leaves=nl, trace_buckets=False)
+
+    return measured
+
+
+def load_budget(path: str = BUDGET) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.split("#")[0].strip()
+                if not raw or "=" not in raw:
+                    continue
+                k, _, v = raw.partition("=")
+                out[k.strip()] = int(v.strip())
+    except OSError:
+        pass
+    return out
+
+
+def write_budget(measured: Dict[str, int], path: str = BUDGET) -> None:
+    lines = [
+        "# Retrace budget (tools/check_retraces.py): EXACT number of",
+        "# library jit traces per canonical scenario, counted via",
+        "# jax.monitoring /lgbtpu/trace/* events on CPU.  A failing",
+        "# entry means a retrace regression (or an intentional trace-",
+        "# family change: re-pin with `python tools/check_retraces.py",
+        "# --update` and justify the diff in review).",
+        "",
+    ]
+    for k in sorted(measured):
+        lines.append(f"{k} = {measured[k]}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def check(measured: Dict[str, int],
+          budget: Dict[str, int]) -> List[str]:
+    findings: List[str] = []
+    for k in sorted(measured):
+        if k not in budget:
+            findings.append(f"unpinned counter: {k} = {measured[k]} "
+                            "(add it to tools/retrace_budget.txt)")
+        elif measured[k] != budget[k]:
+            findings.append(
+                f"trace budget violated: {k} = {measured[k]}, "
+                f"pinned {budget[k]}")
+    for k in sorted(set(budget) - set(measured)):
+        findings.append(f"stale budget entry (scenario no longer "
+                        f"produces it): {k} = {budget[k]}")
+    # the negative control must PROVE the lint catches unbucketed
+    # regressions: the same sweep without bucketing has to exceed the
+    # bucketed grower budget
+    neg = measured.get("negative_unbucketed.grower", 0)
+    pos = measured.get("leaf_sweep.grower", 0)
+    if neg <= pos:
+        findings.append(
+            f"negative control failed: unbucketed sweep traced the "
+            f"grower {neg}x, not more than the bucketed sweep's {pos}x "
+            "— the lint would not catch a bucketing regression")
+    return findings
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--update", action="store_true",
+                    help="re-pin tools/retrace_budget.txt from this run")
+    ap.add_argument("--budget", default=BUDGET,
+                    help="budget file (tests point this at a temp copy)")
+    args = ap.parse_args()
+
+    # force CPU the supported way (the axon sitecustomize freezes
+    # jax_platforms at interpreter start; the env var is too late —
+    # same pattern as bench.py / tests/conftest.py)
+    import jax
+    if os.environ.get("LGBTPU_RETRACE_DEVICE", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    _install_listener()
+
+    measured = run_matrix()
+    print("measured trace counters:")
+    for k in sorted(measured):
+        print(f"  {k} = {measured[k]}")
+
+    if args.update:
+        write_budget(measured, args.budget)
+        print(f"pinned {len(measured)} counters to {args.budget}")
+        return 0
+
+    findings = check(measured, load_budget(args.budget))
+    if findings:
+        print("retrace lint: trace budget violations:", file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        print(f"\n{len(findings)} finding(s).  If the trace-family "
+              "change is intentional, re-pin with `python "
+              "tools/check_retraces.py --update`", file=sys.stderr)
+        return 1
+    print("retrace lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
