@@ -63,17 +63,69 @@ QUICK_TIMEOUT = 300
 EXTRAS_TIMEOUT = 900
 CPU_TIMEOUT = 420
 
-# bf16/f32 MXU peak per chip for MFU estimate; unknown kinds report FLOP/s.
-PEAK_FLOPS = {
-    "v5lite": 197e12, "v5e": 197e12, "v5p": 459e12,
-    "v4": 275e12, "v6e": 918e12, "v6lite": 918e12,
-}
+# FLOP accounting and the per-device peak table now live in the library
+# (obs/flops.py formulas + obs/attrib.py PEAKS — the measurement
+# substrate telemetry, serving and this bench all share); the private
+# _hist_flops_per_iter / PEAK_FLOPS copies this file used to carry are
+# gone.  Children import them lazily (the parent must never touch jax).
+
+_PROVENANCE = None
+
+
+def _provenance():
+    """Self-describing point metadata (device, library versions, host,
+    git sha) so BENCH_*.json files can be compared across rounds by
+    tools/bench_diff.py without external context.  Device fields are
+    included only when jax is ALREADY imported — the parent process
+    must never trigger a TPU claim for bookkeeping."""
+    global _PROVENANCE
+    if _PROVENANCE is None:
+        import platform
+        prov = {"hostname": platform.node(), "py": platform.python_version()}
+        try:
+            out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                                 capture_output=True, text=True, cwd=_DIR,
+                                 timeout=10)
+            if out.returncode == 0:
+                prov["git_sha"] = out.stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            pass
+        _PROVENANCE = prov
+    prov = dict(_PROVENANCE)
+    if "jax" in sys.modules:          # imported by a measurement child
+        jax = sys.modules["jax"]
+        prov["jax"] = getattr(jax, "__version__", "?")
+        try:
+            import jaxlib
+            prov["jaxlib"] = getattr(jaxlib, "__version__", "?")
+        except ImportError:
+            pass
+        try:
+            from importlib import metadata as _md
+            for dist in ("libtpu", "libtpu-nightly"):
+                try:
+                    prov["libtpu"] = _md.version(dist)
+                    break
+                except _md.PackageNotFoundError:
+                    continue
+        except Exception:
+            pass
+        try:
+            devs = jax.devices()      # already claimed by this child
+            prov["device_kind"] = devs[0].device_kind
+            prov["device_count"] = len(devs)
+        except Exception:
+            pass
+    return prov
 
 
 def _record_point(name, **kv):
     """Append one measured point to the results file IMMEDIATELY (crash /
-    timeout safe) and mirror it to stderr for the log tail."""
-    rec = {"point": name, "t": time.strftime("%Y-%m-%dT%H:%M:%S"), **kv}
+    timeout safe) and mirror it to stderr for the log tail.  Every
+    point carries its provenance (device + versions + git sha) so the
+    file is self-describing for tools/bench_diff.py."""
+    rec = {"point": name, "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "prov": _provenance(), **kv}
     try:
         with open(POINTS_FILE, "a") as f:
             f.write(json.dumps(rec) + "\n")
@@ -82,18 +134,6 @@ def _record_point(name, **kv):
     except OSError as e:
         print(f"[bench] point-file write failed: {e}", file=sys.stderr)
     print(f"[bench] point {rec}", file=sys.stderr, flush=True)
-
-
-def _peak_for(devs):
-    """MXU peak FLOP/s for the claimed device kind, or None if unknown."""
-    kind = devs[0].device_kind.lower().replace(" ", "")
-    return next((v for k, v in PEAK_FLOPS.items() if k in kind), None)
-
-
-def _hist_flops_per_iter(n: int, leaves: int) -> float:
-    """Useful histogram FLOPs per boosting iteration (one-hot
-    contraction, (leaves-1) smaller-child passes)."""
-    return 2.0 * 3 * n * N_FEAT * PRIMARY_PADDED_BIN * (leaves - 1)
 
 
 def make_higgs_like(n: int, f: int, seed: int = 0):
@@ -256,19 +296,30 @@ def child_primary() -> None:
     }
     if cpu:
         rec["unit"] += f" [CPU fallback, {n} rows]"
+    # roofline attribution from the library ledger (obs/flops.py /
+    # obs/attrib.py — the same formulas telemetry_snapshot uses):
+    # achieved histogram FLOP/s, MFU against the claimed device's peak,
+    # and the static per-phase FLOP share — first-class in the point
+    from lightgbm_tpu.obs.attrib import device_peaks
+    from lightgbm_tpu.obs.flops import (FlopLedger,
+                                        train_hist_flops_per_iter)
+    achieved = train_hist_flops_per_iter(
+        n, N_FEAT, PRIMARY_MAX_BIN, PRIMARY_LEAVES) * ips1
+    peak, _bw = device_peaks(devs)
+    mfu = round(achieved / peak, 4) if peak else None
+    share = FlopLedger.for_training(
+        n, N_FEAT, PRIMARY_MAX_BIN, split_batch=8).flop_share(
+        steps1[-1] if steps1 else PRIMARY_LEAVES - 1)
     # persist + emit the primary record NOW: a later timeout kill (or a
     # hang in the strict point) must not discard it
     _record_point("primary", auc=round(float(auc1), 4), cpu=cpu,
                   steps_per_tree=steps1[-1] if steps1 else None,
-                  **stats1, **rec)
+                  hist_tflops=round(achieved / 1e12, 3), mfu=mfu,
+                  flop_share=share, **stats1, **rec)
     print(json.dumps(rec), flush=True)
-
-    # observability: achieved histogram FLOP/s + MFU estimate
-    achieved = _hist_flops_per_iter(n, PRIMARY_LEAVES) * ips1
-    peak = _peak_for(devs)
-    mfu = f"{achieved / peak:.1%}" if peak else "n/a"
     print(f"[bench] primary {ips1:.2f} iters/s train-AUC={auc1:.4f} "
-          f"hist~{achieved / 1e12:.2f} TFLOP/s (MFU~{mfu} of "
+          f"hist~{achieved / 1e12:.2f} TFLOP/s "
+          f"(MFU~{f'{mfu:.1%}' if mfu is not None else 'n/a'} of "
           f"{devs[0].device_kind})", file=sys.stderr, flush=True)
 
     if not quick and not cpu:
@@ -314,8 +365,15 @@ def child_extras() -> None:
         ips2, auc2, ds2, st2, cst2 = _train_point(
             lgb, x, y, num_leaves=255, chunk=4,
             n_chunks=2, tag=f"{n//1000}k/255leaf", learner=learner)
-        flops = _hist_flops_per_iter(n, 255) * ips2
-        peak = _peak_for(devs)
+        from lightgbm_tpu.obs.attrib import device_peaks
+        from lightgbm_tpu.obs.flops import (FlopLedger,
+                                            train_hist_flops_per_iter)
+        flops = train_hist_flops_per_iter(
+            n, N_FEAT, PRIMARY_MAX_BIN, 255) * ips2
+        peak, _bw = device_peaks(devs)
+        share255 = FlopLedger.for_training(
+            n, N_FEAT, PRIMARY_MAX_BIN, split_batch=16).flop_share(
+            st2[-1] if st2 else 254)
         _record_point("higgs1m_255leaf", value=round(ips2, 3),
                       auc=round(float(auc2), 4), cpu=cpu,
                       steps_per_tree=st2[-1] if st2 else None,
@@ -323,6 +381,7 @@ def child_extras() -> None:
                                    if not cpu else None),
                       hist_tflops=round(flops / 1e12, 2),
                       mfu=round(flops / peak, 4) if peak else None,
+                      flop_share=share255,
                       **cst2)
     except Exception as e:
         _record_point("higgs1m_255leaf",
@@ -633,15 +692,18 @@ def main():
                 extra["higgs1m_31leaf_sb8_auc"] = p["auc"]
                 if p.get("steps_per_tree") is not None:
                     extra["higgs1m_31leaf_sb8_steps"] = p["steps_per_tree"]
-                for k_src in ("compile_s", "trace_count"):
+                for k_src in ("compile_s", "trace_count", "hist_tflops",
+                              "mfu", "flop_share"):
                     if p.get(k_src) is not None:
                         extra[f"higgs1m_31leaf_sb8_{k_src}"] = p[k_src]
+                if p.get("prov"):
+                    rec["prov"] = p["prov"]
             continue
         if "value" not in p and "error" not in p:
             # keyed payload points (hist-bytes shapes, comm_bytes_per_iter
             # from the obs/comm static model): fold every data key
             for k_src, v in p.items():
-                if k_src not in ("point", "t", "cpu"):
+                if k_src not in ("point", "t", "cpu", "prov"):
                     extra[f"{name}_{k_src}"] = v
             continue
         if "value" in p:
@@ -652,6 +714,7 @@ def main():
                                  ("batched_over_strict", "_speedup"),
                                  ("hist_tflops", "_hist_tflops"),
                                  ("mfu", "_mfu"),
+                                 ("flop_share", "_flop_share"),
                                  # compile wall metrics (ROADMAP item 4):
                                  # first-class in every train point
                                  ("compile_s", "_compile_s"),

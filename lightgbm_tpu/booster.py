@@ -238,14 +238,32 @@ class Booster:
         ``compile.cache_hits`` / ``compile.cache_misses`` (persistent
         cache), ``compile.traces`` (library jit traces) — so warm-start
         is observable, not assumed (docs/Compile-Cache.md).
-        Multi-process: per-shard obs registries are gathered and
-        merged, so every process sees host 0's aggregated view."""
+
+        With telemetry on, the ``perf.*`` roofline keys join the
+        static flop ledger with the fenced phase spans: per-phase
+        flops / hbm_bytes (deterministic, dp == serial), achieved
+        FLOP/s and bytes/s, MFU against the device peak table, and a
+        compute-vs-memory ``bound`` verdict (obs/attrib.py,
+        docs/Observability.md "Roofline & flight recorder").
+
+        Returns a DEEP COPY: callers may mutate the result freely
+        without corrupting the live registry/ledger state the next
+        snapshot is built from.  Multi-process: per-shard obs
+        registries are gathered and merged, so every process sees
+        host 0's aggregated view."""
+        import copy
         m = self._model
-        snap = {} if m is None or getattr(m, "_obs", None) is None \
-            else dict(m._obs.snapshot())
+        obs = None if m is None else getattr(m, "_obs", None)
+        snap = {} if obs is None else dict(obs.snapshot())
         from .utils.compile_cache import compile_snapshot
         snap.update(compile_snapshot())
-        return snap
+        if obs is not None:
+            # no-op (returns {}) unless flops.* counters exist — on a
+            # multi-process pod the gathered snapshot carries host 0's
+            # ledger counters, so every process derives the same keys
+            from .obs.attrib import perf_summary
+            snap.update(perf_summary(snap, peaks=obs.peaks))
+        return copy.deepcopy(snap)
 
     def telemetry_finish(self) -> dict:
         """Stop any active profiler window, flush the JSONL trace sink,

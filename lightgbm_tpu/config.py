@@ -245,6 +245,23 @@ _PARAMS: Dict[str, tuple] = {
     # [k, n] — capture iterations [k, k+n) with jax.profiler (best
     # effort; requires telemetry=true).  [k] captures one iteration
     "telemetry_profile_iters": (list, None, []),
+    # flight recorder (obs/blackbox.py): keep a bounded ring of
+    # per-iteration records (phase seconds, eval results, finite-guard
+    # flags, static comm/flop counters) and dump the last K as JSONL on
+    # exception, watchdog fire, or a finite_check_policy trigger.
+    # false (default) allocates nothing and never touches disk
+    "telemetry_blackbox": (bool, False, []),
+    # dump path; empty derives <output_model>.blackbox.jsonl (train)
+    # or lgbtpu_serve_blackbox.jsonl (serve)
+    "telemetry_blackbox_path": (str, "", []),
+    # ring capacity: how many trailing iteration records a dump holds
+    "telemetry_blackbox_last_k": (int, 64, []),
+    # roofline peak overrides for device kinds obs/attrib.py's table
+    # does not know (0 = auto-detect from the device kind): MXU peak
+    # FLOP/s and HBM bandwidth in GB/s — the denominators of the
+    # perf.* mfu / bound keys
+    "telemetry_peak_flops": (float, 0.0, []),
+    "telemetry_peak_hbm_gbs": (float, 0.0, []),
     # ---- fault tolerance ----
     # retries after the first failed device-claim / jax.distributed
     # bring-up attempt (jittered exponential backoff, utils/resilience.py)
@@ -566,6 +583,11 @@ class Config:
                 and len(self.telemetry_profile_iters) not in (1, 2):
             raise ValueError(
                 "telemetry_profile_iters must be [start] or [start, count]")
+        if self.telemetry_blackbox_last_k < 1:
+            raise ValueError("telemetry_blackbox_last_k must be >= 1")
+        for knob in ("telemetry_peak_flops", "telemetry_peak_hbm_gbs"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0 (0 = auto-detect)")
         pb = str(self.predict_bucketed).strip().lower()
         if pb in ("true", "1", "+", "yes", "on"):
             self.predict_bucketed = "true"

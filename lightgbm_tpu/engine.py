@@ -172,7 +172,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
                           evaluation_result_list=None)
         for cb in cbs_before:
             cb(env)
-        stopped = booster.update(fobj=fobj)
+        try:
+            stopped = booster.update(fobj=fobj)
+        except Exception:
+            # flight-recorder trigger (obs/blackbox.py): dump the last
+            # K iteration records before the exception propagates —
+            # cheap no-op when no recorder is live
+            from .obs import blackbox
+            blackbox.dump_all("train_exception")
+            raise
         if cfg.verbosity > 1:
             from .utils.log import Log
             Log.info(f"{_time.time() - t_start:.6f} seconds elapsed, "
@@ -197,6 +205,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             if cfg.is_provide_training_metric or train_eval_name is not None:
                 evals.extend(booster.eval_train(feval))
             evals.extend(booster.eval_valid(feval))
+        if evals:
+            # flight recorder: fold the train/valid metrics (computed
+            # after the iteration record landed) into that record
+            bb = getattr(getattr(booster, "_model", None), "_bbox", None)
+            if bb is not None:
+                bb.annotate_last(evals=[[nm, met, float(v)]
+                                        for (nm, met, v, _) in evals])
         env = CallbackEnv(model=booster, params=params, iteration=i,
                           begin_iteration=0, end_iteration=num_boost_round,
                           evaluation_result_list=evals)

@@ -637,6 +637,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             can_split = (st.bg[leaf] > 0.0) & (~st.done)
 
             def do_split(st: _GrowState) -> _GrowState:
+                # partition-site static accounting (obs/flops.py): a
+                # trace-time Python side effect, zero runtime cost
+                from .obs.flops import note_traced, partition_flops_bytes
+                note_traced("partition", *partition_flops_bytes(n),
+                            phase="grow")
                 new_leaf = (i + 1).astype(jnp.int32)
                 feat, thr = st.bf[leaf], st.bt[leaf]
                 dleft = st.bdl[leaf]
@@ -884,6 +889,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             can_split = valid[0]
 
             def do_split(st: _GrowState) -> _GrowState:
+                # one partition pass serves all K splits of the super-
+                # step (trace-time note; obs/flops.py)
+                from .obs.flops import note_traced, partition_flops_bytes
+                note_traced("partition", *partition_flops_bytes(n),
+                            phase="grow")
                 leaf_sel = jnp.where(valid, leaves, L + kidx)
                 node_sel = jnp.where(valid, num_nodes + kidx,
                                      jnp.int32(L - 1) + kidx)

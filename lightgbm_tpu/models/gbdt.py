@@ -555,10 +555,56 @@ class GBDTModel:
         # host syncs and no per-iteration allocation beyond the branch
         from ..obs import maybe_session
         self._obs = maybe_session(config)
+        self._flops = None
         if self._obs is not None:
             ledger = getattr(self.grower, "comm", None)
             if ledger is not None:
                 self._obs.attach_comm_sites(ledger)
+            # static compute ledger (obs/flops.py) from LOGICAL GLOBAL
+            # shapes — identical between tree_learner=data and serial,
+            # independent of jit-cache state.  Attached on process 0
+            # only: the ledger accounts the global work, so a
+            # per-process attach would multiply it by the process
+            # count when snapshots aggregate.
+            # peaks are process-independent (config override or the
+            # device-kind table) — attached everywhere so every
+            # process's perf.* join carries the same mfu/bound keys
+            from ..obs.attrib import config_peaks
+            self._obs.attach_peaks(*config_peaks(config))
+            if _jax.process_index() == 0:
+                from ..obs.flops import FlopLedger
+                n_global = (int(self._global_counts.sum())
+                            if self._global_counts is not None
+                            else self.num_data)
+                if self._sparse:
+                    hist_cols, itemsize = self.num_features, 4
+                else:
+                    hist_cols = int(self.binned_dev.shape[1])
+                    itemsize = int(self.binned_dev.dtype.itemsize)
+                self._flops = FlopLedger.for_training(
+                    n_rows=n_global, n_feat=self.num_features,
+                    num_bins=self.max_bin,
+                    split_batch=self._split_batch,
+                    hist_cols=hist_cols,
+                    hist_bins=(int(self.efb_dev.group_bins)
+                               if self.efb_dev is not None
+                               else self.max_bin),
+                    binned_itemsize=itemsize,
+                    num_class=self.num_class)
+                self._obs.attach_flop_sites(self._flops)
+        # flight recorder (obs/blackbox.py): None unless
+        # telemetry_blackbox=true — zero ring allocation, no file
+        from ..obs.blackbox import maybe_recorder
+        self._bbox = maybe_recorder(
+            config,
+            default_path=((config.output_model + ".blackbox.jsonl")
+                          if getattr(config, "output_model", "")
+                          else "lgbtpu_blackbox.jsonl"),
+            meta={"surface": "train", "objective": config.objective,
+                  "num_leaves": config.num_leaves,
+                  "tree_learner": config.tree_learner,
+                  "learner": self._learner_kind,
+                  "split_batch": self._split_batch})
 
     def _fit_linear_leaves(self, arrays: TreeArrays, ht: Tree, g, h, w,
                            shrinkage: float, bias: float) -> None:
@@ -1192,6 +1238,11 @@ class GBDTModel:
                     dead = dead | ((arrays.num_leaves <= 1) & ~bad)
                 delta = jnp.where(ok > 0.0,
                                   jnp.take(lv, arrays.leaf_of_row), 0.0)
+                from ..obs.flops import (note_traced,
+                                         score_update_flops_bytes)
+                note_traced("score",
+                            *score_update_flops_bytes(score.shape[0]),
+                            phase="score", cadence="iter")
                 score = score.at[:, 0].add(delta)
                 if fin_freq > 0 and fin_policy == "skip_iter":
                     # a tripped check heals the score carry too: a NaN
@@ -1281,6 +1332,12 @@ class GBDTModel:
                 msg = ("non-finite gradient/hessian or leaf output "
                        f"detected at iteration {it0 + j + 1} "
                        f"(finite_check_freq={cfg.finite_check_freq})")
+                if self._bbox is not None:
+                    self._bbox.record(event="finite_check_trip",
+                                      iteration=it0 + j + 1,
+                                      policy=cfg.finite_check_policy,
+                                      fused=True)
+                    self._bbox.dump("finite_check")
                 if cfg.finite_check_policy == "raise":
                     from ..basic import LightGBMError
                     raise LightGBMError(
@@ -1336,6 +1393,20 @@ class GBDTModel:
             obs.metrics.counter("train.fused_chunks").inc()
             for s in self.step_counts[len(self.step_counts) - done:]:
                 obs.metrics.histogram("train.steps_per_tree").observe(s)
+                obs.record_flops(s)
+        if self._bbox is not None:
+            done = self.iter_ - start_iter
+            rec = {"event": "fused_chunk", "iterations": done,
+                   "first_iteration": start_iter + 1,
+                   "steps": self.step_counts[len(self.step_counts)
+                                             - done:]}
+            if self._flops is not None:
+                fl = hb = 0
+                for s in rec["steps"]:
+                    f_, b_ = self._flops.per_iteration(s)
+                    fl, hb = fl + f_, hb + b_
+                rec["flops"], rec["hbm_bytes"] = fl, hb
+            self._bbox.record(**rec)
         self._last_iter_state = None    # rollback not supported past a chunk
         return stopped
 
@@ -1346,6 +1417,10 @@ class GBDTModel:
         cfg = self.config
         obs = self._obs
         t_iter0 = obs.iter_begin(self.iter_) if obs is not None else 0.0
+        bbox = self._bbox
+        if bbox is not None:
+            import time as _time
+            t_bb0 = _time.perf_counter()
         init_scores = [0.0] * self.num_class
         if self.iter_ == 0 and self.objective is not None \
                 and cfg.boost_from_average and not self._init_applied:
@@ -1512,6 +1587,14 @@ class GBDTModel:
                     msg = ("non-finite gradient/hessian or leaf output "
                            f"detected at iteration {it_global + 1} "
                            f"(finite_check_freq={fin_freq})")
+                    if bbox is not None:
+                        # the finite guard IS a flight-recorder trigger:
+                        # dump the trailing ring before acting on the
+                        # policy so the post-mortem survives a raise
+                        bbox.record(event="finite_check_trip",
+                                    iteration=it_global + 1,
+                                    policy=fin_policy)
+                        bbox.dump("finite_check")
                     if fin_policy == "raise":
                         from ..basic import LightGBMError
                         raise LightGBMError(
@@ -1597,6 +1680,14 @@ class GBDTModel:
                 self.score = self.score.at[:, k].add(delta)
             if obs is not None:
                 obs.phase_metric("score", _sp.end(self.score))
+                # score-update site note (obs/flops.py) — host-side
+                # arithmetic only, gated so the telemetry-off path
+                # stays exactly one is-None branch
+                from ..obs.flops import (note_traced,
+                                         score_update_flops_bytes)
+                note_traced("score",
+                            *score_update_flops_bytes(self.num_data),
+                            phase="score", cadence="iter")
             iter_state["train_deltas"].append(delta)
 
             steps = round_up_pow2(max(ht.max_depth(), 1))
@@ -1648,6 +1739,22 @@ class GBDTModel:
             # toward its step/comm accounting
             obs.iter_end(self.iter_ - 1, t_iter0,
                          sum(self.step_counts[-self.num_class:]))
+        if bbox is not None:
+            # one host-side record per iteration (no device syncs: all
+            # fields are values the driver already holds)
+            import time as _time
+            steps = sum(self.step_counts[-self.num_class:])
+            rec = {"iteration": self.iter_,
+                   "dur_s": round(_time.perf_counter() - t_bb0, 6),
+                   "steps": steps, "stopped": stopped,
+                   "skipped": heal_score}
+            if self._flops is not None:
+                fl, hb = self._flops.per_iteration(steps)
+                rec["flops"], rec["hbm_bytes"] = fl, hb
+            comm = getattr(self.grower, "comm", None)
+            if comm is not None:
+                rec["comm_wire_bytes"] = comm.bytes_per_iteration(steps)
+            bbox.record(**rec)
         return stopped
 
     def rollback_one_iter(self) -> None:

@@ -17,6 +17,16 @@ attribution was built from:
                 call sites of the distributed learners (no extra syncs:
                 byte math is derived from traced shapes at compile
                 time, arXiv:1706.08359's instrumentation discipline).
+- ``flops``     the compute-side mirror of ``comm``: static FLOP + HBM
+                byte accounting for the histogram/split/partition/
+                score/traversal sites, per-model ``FlopLedger``.
+- ``attrib``    roofline attribution: joins the flop ledger with the
+                fenced phase spans and a per-device peak table into
+                the ``perf.*`` keys (achieved FLOP/s, MFU,
+                compute-vs-memory verdict).
+- ``blackbox``  flight recorder: bounded ring of per-iteration
+                records dumped as JSONL on exception / watchdog /
+                finite-guard trigger.
 - ``profiler``  opt-in ``jax.profiler`` capture of an iteration window.
 
 ``ObsSession`` ties the four together for a training run; it is built
@@ -62,6 +72,10 @@ class ObsSession:
                 ((trace_file + ".profile") if trace_file
                  else "lgbtpu_profile"))
         self._comm_sites = ()
+        self._flop_sites = None
+        # (peak FLOP/s, peak HBM bytes/s) for the roofline join;
+        # attached by the driver (obs/attrib.config_peaks)
+        self.peaks = (None, None)
         from ..utils import timer as _timer
         _timer.global_timer.enabled = True   # FunctionTimer scopes feed in
         _set_compile_watch_target(self)
@@ -79,6 +93,7 @@ class ObsSession:
         self.metrics.histogram("train.iter_seconds").observe(
             self.tracer.now() - t0)
         self.record_comm(n_steps)
+        self.record_flops(n_steps)
         if self.profiler is not None:
             self.profiler.on_iter_end(it)
 
@@ -112,6 +127,35 @@ class ObsSession:
                 site.payload_bytes * mult)
             self.metrics.counter("comm.wire_bytes", **labels).inc(
                 site.wire_bytes * mult)
+
+    # -- compute accounting ------------------------------------------------
+    def attach_flop_sites(self, ledger) -> None:
+        """Register the driver's static compute ledger (obs/flops.py
+        FlopLedger, built from LOGICAL GLOBAL shapes); per-iteration
+        FLOP/HBM-byte counters are derived from it host-side.  Under
+        multi-process training the driver attaches on process 0 only —
+        the ledger already accounts the global work, so a per-process
+        attach would multiply it by the process count at aggregation."""
+        self._flop_sites = ledger
+
+    def attach_peaks(self, peak_flops, peak_bw) -> None:
+        self.peaks = (peak_flops, peak_bw)
+
+    @property
+    def flop_sites(self):
+        return self._flop_sites
+
+    def record_flops(self, n_steps: int) -> None:
+        for site in (self._flop_sites.sites()
+                     if self._flop_sites is not None else ()):
+            mult = n_steps if site.cadence == "step" else 1
+            if mult <= 0:
+                continue
+            labels = dict(phase=site.phase, site=site.site)
+            self.metrics.counter("flops.total", **labels).inc(
+                site.flops * mult)
+            self.metrics.counter("flops.hbm_bytes", **labels).inc(
+                site.hbm_bytes * mult)
 
     # -- snapshot / finish ------------------------------------------------
     def snapshot(self, gather: bool = True) -> dict:

@@ -1,0 +1,166 @@
+"""Flight recorder: a bounded ring of per-iteration records, dumped as
+JSONL when something goes wrong.
+
+The post-mortem counterpart of the live telemetry: with
+``telemetry_blackbox=true`` the training driver (and the serve batch
+path) append one small host-side record per iteration/batch — phase
+seconds, train/valid metric, finite-guard flags, static comm/flop
+counters — into a ``deque(maxlen=K)``.  On an exception, a watchdog
+fire (utils/resilience.Watchdog), or a ``finite_check_policy``
+trigger, the last K records are written as JSONL
+(:func:`~lightgbm_tpu.obs.trace.read_jsonl`-parseable: one header
+line with the dump reason, then one line per record, oldest first).
+
+Zero-cost when disabled: :func:`maybe_recorder` returns None (no ring
+allocation, no file is ever created) and every wiring point is a
+single ``is None`` branch.  Recording NEVER touches the device — all
+fields are values the driver already holds host-side, so the sync
+lint stays green with the recorder on.
+
+``dump_all(reason)`` dumps every live recorder in the process — the
+hook the resilience watchdog and the train-loop exception path use so
+one registration point serves every surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, Optional
+
+# live recorders (weak: a dropped Booster must not pin its ring)
+_LIVE: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+# dump throttle, PER REASON: a flapping trigger (e.g. a finite guard
+# tripping every iteration) stops re-writing the file after this many
+# dumps — but only for ITS reason, so the one dump that matters most
+# (the eventual train_exception / watchdog) always still lands.  All
+# dumps os.replace one path, so disk fill is not the concern; repeated
+# fsync on the hot path is.
+MAX_DUMPS_PER_REASON = 8
+
+
+class FlightRecorder:
+    """Bounded per-iteration record ring with crash-dump semantics."""
+
+    def __init__(self, path: str, last_k: int = 64,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.path = os.fspath(path)
+        self.capacity = max(1, int(last_k))
+        self.meta = dict(meta or {})
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._dumps: Dict[str, int] = {}
+        with _LIVE_LOCK:
+            _LIVE.add(self)
+
+    # -- recording (hot path: one dict build + deque append) --------------
+    def record(self, **fields: Any) -> None:
+        rec = {"t": round(time.time(), 3)}
+        rec.update(fields)
+        with self._lock:
+            self._ring.append(rec)
+
+    def annotate_last(self, **fields: Any) -> None:
+        """Merge fields into the newest record (the engine loop adds
+        eval results computed after the iteration record landed)."""
+        with self._lock:
+            if self._ring:
+                self._ring[-1].update(fields)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- dumping -----------------------------------------------------------
+    def dump(self, reason: str) -> Optional[str]:
+        """Write the ring as JSONL (header line + one record per line,
+        oldest first); returns the path, or None when this reason's
+        dump budget is exhausted or the write failed (a failing
+        recorder must never mask the error that triggered it)."""
+        with self._lock:
+            if self._dumps.get(reason, 0) >= MAX_DUMPS_PER_REASON:
+                return None
+            self._dumps[reason] = self._dumps.get(reason, 0) + 1
+            records = list(self._ring)
+        header = {"blackbox": True, "reason": reason,
+                  "t": round(time.time(), 3), "pid": os.getpid(),
+                  "n_records": len(records), "meta": self.meta}
+        try:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            # plain write-then-replace (not resilience.atomic_write: its
+            # fault-injection sites must not fire inside a crash dump)
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(json.dumps(header) + "\n")
+                for rec in records:
+                    f.write(json.dumps(rec, default=str) + "\n")
+                f.flush()
+                try:
+                    os.fsync(f.fileno())
+                except OSError:
+                    pass
+            os.replace(tmp, self.path)
+        except OSError:
+            return None
+        from ..utils.log import Log
+        Log.warning(f"flight recorder: dumped last {len(records)} "
+                    f"record(s) to {self.path} (reason: {reason})")
+        return self.path
+
+    def close(self) -> None:
+        with _LIVE_LOCK:
+            _LIVE.discard(self)
+
+
+def maybe_recorder(config, default_path: str = "lgbtpu_blackbox.jsonl",
+                   meta: Optional[Dict[str, Any]] = None
+                   ) -> Optional[FlightRecorder]:
+    """Build a FlightRecorder from Config params, or None when
+    ``telemetry_blackbox=false`` (the default) — the only thing the
+    hot path ever does with the recorder off is test this None."""
+    if not getattr(config, "telemetry_blackbox", False):
+        return None
+    path = getattr(config, "telemetry_blackbox_path", "") or default_path
+    return FlightRecorder(
+        path, last_k=getattr(config, "telemetry_blackbox_last_k", 64),
+        meta=meta)
+
+
+def any_live() -> bool:
+    with _LIVE_LOCK:
+        return len(_LIVE) > 0
+
+
+def dump_all(reason: str) -> int:
+    """Dump every live recorder; returns how many dumped.  Cheap when
+    none are registered (the disabled-recorder fast path)."""
+    with _LIVE_LOCK:
+        recs = list(_LIVE)
+    n = 0
+    for r in recs:
+        if r.dump(reason) is not None:
+            n += 1
+    return n
+
+
+def watchdog_timer(timeout_s: float, label: str = ""
+                   ) -> Optional[threading.Timer]:
+    """A started daemon timer that dumps every live recorder if a
+    blocking call outlives ``timeout_s`` (armed by
+    utils/resilience.Watchdog next to its faulthandler dump).  Returns
+    None — and costs nothing — when no recorder is live."""
+    if timeout_s <= 0 or not any_live():
+        return None
+    t = threading.Timer(
+        timeout_s, dump_all,
+        args=(f"watchdog:{label}" if label else "watchdog",))
+    t.daemon = True
+    t.start()
+    return t

@@ -1,0 +1,278 @@
+"""Static FLOP + HBM-byte accounting for the compute hot paths.
+
+The compute-side mirror of ``obs/comm.py``'s trace-time static
+accounting trick: "GPU-acceleration for Large-scale Tree Boosting"
+(arXiv:1706.08359) and "Booster" (arXiv:2011.02022) justify their
+kernels with op-level FLOP/byte budgets; here the same numbers are
+derived STATICALLY from shapes, in two complementary channels that
+share ONE set of formula functions:
+
+1. ``note_traced(site, ...)`` — called as a Python side effect inside
+   the traced bodies of the histogram contraction
+   (``ops/histogram.py``), the split scan (``ops/split.py``), the
+   grower's row partition (``grower.py``), the score update
+   (``models/gbdt.py``) and the tree/forest traversals
+   (``predict_device.py``).  Fires once per fresh jit trace (never per
+   execution), records (flops, hbm_bytes) for the shapes actually
+   traced, and overwrites idempotently on retrace — zero runtime cost,
+   zero extra syncs.  ``traced_sites()`` is the process-wide view.
+
+2. ``FlopLedger`` — the per-model site table the GBDT driver builds
+   from its LOGICAL GLOBAL shapes (rows x features x bins, independent
+   of sharding), so the accounting is deterministic, identical between
+   ``tree_learner=data`` and serial, and non-empty even when a warm jit
+   cache means nothing re-traces.  ``obs.ObsSession.record_flops``
+   turns the site table into per-iteration ``flops.*`` counters, and
+   ``obs/attrib.py`` joins them with the fenced phase spans into
+   ``perf.*`` roofline keys.
+
+FLOP conventions (documented so the numbers are comparable run to
+run, not because the constants are exact):
+
+- histogram: 2 FLOPs per multiply-add of the one-hot contraction —
+  ``2 * C * N * F * Bp`` per full-N pass (the MXU useful work; padded
+  bins included because the hardware computes them).  This is exactly
+  the formula ``bench.py`` used to carry privately.
+- split scan / partition / traversal: elementwise-op estimates with
+  per-cell constants documented at each formula.
+
+HBM-byte convention: bytes that MUST cross HBM for the op — operand
+reads + result writes, assuming perfect fusion of generated
+intermediates (the XLA behavior ``ops/histogram.py`` measured: the
+one-hot never materializes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, NamedTuple, Tuple
+
+
+class FlopSite(NamedTuple):
+    site: str         # stable call-site name, e.g. "hist"
+    phase: str        # iteration phase the time lands in (grad/grow/score)
+    flops: int        # FLOPs per execution of the site
+    hbm_bytes: int    # HBM bytes per execution (reads + writes)
+    cadence: str      # "step" (per grower loop step) | "iter" (per iter)
+
+
+def padded_bins(num_bins: int) -> int:
+    """The histogram kernel's padded bin axis (ops/histogram.py pads to
+    a multiple of 64 so the merge is a free relayout) — the bin width
+    FLOP accounting must use, because the hardware computes the pad."""
+    return max(64, -(-int(num_bins) // 64) * 64)
+
+
+# ---------------------------------------------------------------------------
+# Formula functions — the ONE definition each call site and the driver
+# ledger share.  All return (flops, hbm_bytes) ints.
+# ---------------------------------------------------------------------------
+
+def hist_flops_bytes(n_rows: int, n_cols: int, num_bins: int,
+                     channels: int = 3,
+                     binned_itemsize: int = 1) -> Tuple[int, int]:
+    """One full-N one-hot-contraction histogram pass over ``n_cols``
+    binned columns (features, or EFB groups): ``hist[c, f*Bp] +=
+    vals[c, n] @ onehot[n, f*Bp]`` — 2 FLOPs per MAC.  ``channels`` is
+    the accumulated channel count (3 strict; 3K for the split_batch
+    multi-leaf contraction).  Bytes: binned matrix read + raw
+    (grad, hess, weight) vals read (+ the [N] slot vector when the
+    per-slot expansion is active) + histogram write; the one-hot is
+    generated in-registers (measured fused, ops/histogram.py)."""
+    bp = padded_bins(num_bins)
+    flops = 2 * int(channels) * int(n_rows) * int(n_cols) * bp
+    hbm = (int(n_rows) * int(n_cols) * int(binned_itemsize)
+           + int(n_rows) * 3 * 4
+           + (int(n_rows) * 4 if channels > 3 else 0)
+           + int(channels) * int(n_cols) * bp * 4)
+    return flops, hbm
+
+
+# elementwise ops per (direction, feature, bin) cell of the numerical
+# split scan: cumsum add, left/right sums (6), two leaf gains (~2x8),
+# gain shift + subtract (3), six validity masks + where (~12), argmax
+# compare (1) — a documented estimate, stable across runs
+SPLIT_SCAN_OPS_PER_CELL = 40
+# bytes per (feature, bin) cell: hist read [3] f32 + the two-direction
+# gain tensor write+read [2 x 2] f32
+SPLIT_SCAN_BYTES_PER_CELL = 4 * (3 + 4)
+
+
+def split_scan_flops_bytes(n_feat: int, num_bins: int,
+                           n_leaves: int = 1) -> Tuple[int, int]:
+    """Best-split scan over ``n_leaves`` candidate leaves: the two
+    directional scans over the ``[2, F, B]`` gain tensor
+    (ops/split.py find_best_split), VPU elementwise work."""
+    cells = 2 * int(n_feat) * int(num_bins) * int(n_leaves)
+    return (SPLIT_SCAN_OPS_PER_CELL * cells,
+            SPLIT_SCAN_BYTES_PER_CELL
+            * int(n_feat) * int(num_bins) * int(n_leaves))
+
+
+# per-row ops of one partition pass: feature-column gather, NaN test,
+# rank gather, threshold compare, leaf-id select
+PARTITION_OPS_PER_ROW = 5
+
+
+def partition_flops_bytes(n_rows: int,
+                          binned_itemsize: int = 1) -> Tuple[int, int]:
+    """One row-partition pass (grower do_split / super_step): gather
+    the winning feature's column, compare, rewrite ``leaf_of_row``.
+    Bytes: column read + leaf_of_row read+write (int32)."""
+    n = int(n_rows)
+    return (PARTITION_OPS_PER_ROW * n,
+            n * int(binned_itemsize) + 2 * n * 4)
+
+
+def score_update_flops_bytes(n_rows: int) -> Tuple[int, int]:
+    """Per-iteration score update: ``score += leaf_value[leaf_of_row]``
+    — one gather + one add per row; leaf_of_row read, score
+    read-modify-write."""
+    n = int(n_rows)
+    return 2 * n, n * 4 + 2 * n * 4
+
+
+# per (row, tree, level) ops of the binned traversal: node gather,
+# feature gather, bin gather, NaN test, rank gather, compare,
+# child select, finished-row select
+TRAVERSE_OPS_PER_STEP = 8
+# bytes per (row, tree, level): ~6 gathered int32 words
+TRAVERSE_BYTES_PER_STEP = 6 * 4
+
+
+def traverse_flops_bytes(n_rows: int, n_trees: int, steps: int,
+                         n_feat: int,
+                         binned_itemsize: int = 1) -> Tuple[int, int]:
+    """Fixed-depth binned traversal (predict_device.py): every row
+    walks ``n_trees`` trees one level per step for ``steps`` levels.
+    Bytes add one read of the binned matrix."""
+    per_level = int(n_rows) * int(n_trees) * int(steps)
+    return (TRAVERSE_OPS_PER_STEP * per_level,
+            TRAVERSE_BYTES_PER_STEP * per_level
+            + int(n_rows) * int(n_feat) * int(binned_itemsize))
+
+
+def train_hist_flops_per_iter(n_rows: int, n_feat: int, num_bins: int,
+                              num_leaves: int) -> float:
+    """Useful histogram FLOPs per boosting iteration: one C=3 full-N
+    contraction per smaller-child pass, (num_leaves - 1) passes/tree —
+    the headline number bench.py reports (its former private
+    ``_hist_flops_per_iter``, now derived from the shared formula)."""
+    f, _ = hist_flops_bytes(n_rows, n_feat, num_bins, channels=3)
+    return float(f) * (int(num_leaves) - 1)
+
+
+# ---------------------------------------------------------------------------
+# Channel 1: trace-time site notes (process-global, like trace_event)
+# ---------------------------------------------------------------------------
+
+_TRACED_LOCK = threading.Lock()
+_TRACED: Dict[str, FlopSite] = {}
+
+
+def note_traced(site: str, flops: int, hbm_bytes: int,
+                phase: str = "", cadence: str = "step") -> None:
+    """Record a site's static accounting from TRACED shapes.  Called
+    inside jitted function bodies, so it fires once per fresh trace and
+    overwrites idempotently on retrace — the latest traced shapes win
+    (the process-wide view; per-model attribution goes through the
+    driver's FlopLedger, which never depends on jit-cache state)."""
+    with _TRACED_LOCK:
+        _TRACED[site] = FlopSite(site=site, phase=phase, flops=int(flops),
+                                 hbm_bytes=int(hbm_bytes), cadence=cadence)
+
+
+def traced_sites() -> Dict[str, FlopSite]:
+    """Process-wide snapshot of the trace-time site notes."""
+    with _TRACED_LOCK:
+        return dict(_TRACED)
+
+
+# ---------------------------------------------------------------------------
+# Channel 2: the per-model ledger
+# ---------------------------------------------------------------------------
+
+class FlopLedger:
+    """Per-model static compute ledger, the compute sibling of
+    ``obs/comm.CommLedger``: a table of (site, phase, flops, hbm_bytes,
+    cadence) built from LOGICAL GLOBAL shapes so serial and
+    ``tree_learner=data`` produce byte-identical accounting."""
+
+    def __init__(self):
+        self._sites: Dict[str, FlopSite] = {}
+
+    def add(self, site: str, phase: str, flops: int, hbm_bytes: int,
+            cadence: str = "step") -> None:
+        self._sites[site] = FlopSite(site=site, phase=phase,
+                                     flops=int(flops),
+                                     hbm_bytes=int(hbm_bytes),
+                                     cadence=cadence)
+
+    def sites(self) -> Tuple[FlopSite, ...]:
+        return tuple(self._sites[k] for k in sorted(self._sites))
+
+    def per_iteration(self, n_steps: int) -> Tuple[int, int]:
+        """(flops, hbm_bytes) for one boosting iteration that ran
+        ``n_steps`` grower loop steps."""
+        f = b = 0
+        for s in self.sites():
+            mult = n_steps if s.cadence == "step" else 1
+            f += s.flops * mult
+            b += s.hbm_bytes * mult
+        return f, b
+
+    def flop_share(self, n_steps: int) -> Dict[str, float]:
+        """Static per-site share of one iteration's FLOPs — the
+        "where would the nanoseconds go on ideal hardware" split every
+        bench point records alongside the measured rate."""
+        total, _ = self.per_iteration(n_steps)
+        if total <= 0:
+            return {}
+        return {s.site: round(s.flops
+                              * (n_steps if s.cadence == "step" else 1)
+                              / total, 4)
+                for s in self.sites()}
+
+    @classmethod
+    def for_training(cls, n_rows: int, n_feat: int, num_bins: int,
+                     split_batch: int = 1, hist_cols: int = None,
+                     hist_bins: int = None, binned_itemsize: int = 1,
+                     num_class: int = 1) -> "FlopLedger":
+        """The training-loop site table for the masked grower family.
+
+        ``hist_cols``/``hist_bins``: the histogram pass's column/bin
+        axes when they differ from the scan space (EFB bundles build
+        G-column histograms at the max group-bin width, then expand to
+        F features for the scan); default to ``n_feat``/``num_bins``.
+        ``num_class``: trees grown per iteration — iter-cadence sites
+        run once PER CLASS, so their per-iteration values carry the
+        factor (step-cadence sites get it through the summed
+        across-class step count the driver records).  Sites:
+
+        - ``hist``       smaller-child contraction, C=3K, per step
+        - ``hist_root``  root contraction, C=3, per class per iter
+        - ``split_scan`` 2K candidate leaves per step
+        - ``split_root`` root scan, per class per iteration
+        - ``partition``  one row pass per step
+        - ``score``      leaf-gather score update, per class per iter
+        """
+        k = max(1, int(split_batch))
+        nc = max(1, int(num_class))
+        hc = int(hist_cols) if hist_cols else int(n_feat)
+        hb = int(hist_bins) if hist_bins else int(num_bins)
+        led = cls()
+        f, b = hist_flops_bytes(n_rows, hc, hb, channels=3 * k,
+                                binned_itemsize=binned_itemsize)
+        led.add("hist", "grow", f, b, "step")
+        f, b = hist_flops_bytes(n_rows, hc, hb, channels=3,
+                                binned_itemsize=binned_itemsize)
+        led.add("hist_root", "grow", f * nc, b * nc, "iter")
+        f, b = split_scan_flops_bytes(n_feat, num_bins, n_leaves=2 * k)
+        led.add("split_scan", "grow", f, b, "step")
+        f, b = split_scan_flops_bytes(n_feat, num_bins, n_leaves=1)
+        led.add("split_root", "grow", f * nc, b * nc, "iter")
+        f, b = partition_flops_bytes(n_rows, binned_itemsize)
+        led.add("partition", "grow", f, b, "step")
+        f, b = score_update_flops_bytes(n_rows)
+        led.add("score", "score", f * nc, b * nc, "iter")
+        return led

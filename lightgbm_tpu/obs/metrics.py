@@ -201,6 +201,102 @@ def aggregate_snapshots(snaps: List[Dict[str, Dict[str, Any]]]
     return dict(sorted(out.items()))
 
 
+_PROM_NAME_RE = None
+
+
+def _prom_name(name: str) -> str:
+    global _PROM_NAME_RE
+    if _PROM_NAME_RE is None:
+        import re
+        _PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+    out = _PROM_NAME_RE.sub("_", name)
+    return "_" + out if out and out[0].isdigit() else out
+
+
+def _prom_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    esc = {k: str(v).replace("\\", "\\\\").replace('"', '\\"')
+           for k, v in labels.items()}
+    return "{" + ",".join(f'{_prom_name(k)}="{esc[k]}"'
+                          for k in sorted(esc)) + "}"
+
+
+def _parse_key(key: str):
+    """``name{a=b,c=d}`` snapshot key -> (name, labels dict)."""
+    base, brace, rest = key.partition("{")
+    labels: Dict[str, str] = {}
+    if brace:
+        for part in rest[:-1].split(","):
+            k, _, v = part.partition("=")
+            if k:
+                labels[k] = v
+    return base, labels
+
+
+def prometheus_text(snap: Dict[str, Any]) -> str:
+    """Render a metrics snapshot in the Prometheus text exposition
+    format (v0.0.4) — the ``?format=prom`` answer of the serve
+    ``/metrics`` endpoint.
+
+    Typed instruments map directly (histograms emit cumulative
+    ``_bucket``/``_sum``/``_count`` series with ``le`` labels); plain
+    numeric entries (``compile.*``, ``perf.*``) become gauges; string
+    entries (the roofline ``bound`` verdicts) become info-style
+    ``name{value="..."} 1`` gauges; nested plain dicts
+    (``serve.engine``, ``serve.latency_quantiles``,
+    ``compile.traces`` by-name) flatten one level, numeric leaves
+    only.  Deterministic: keys sorted, one ``# TYPE`` line per
+    metric family."""
+    lines: List[str] = []
+    typed: Dict[str, str] = {}
+
+    def emit(name: str, typ: str, labels: Dict[str, str],
+             value: float) -> None:
+        pname = _prom_name(name)
+        if pname not in typed:
+            typed[pname] = typ
+            lines.append(f"# TYPE {pname} {typ}")
+        lines.append(f"{pname}{_prom_labels(labels)} {value!r}")
+
+    for key in sorted(snap):
+        rec = snap[key]
+        name, labels = _parse_key(key)
+        if isinstance(rec, bool):
+            emit(name, "gauge", labels, float(rec))
+        elif isinstance(rec, (int, float)):
+            emit(name, "gauge", labels, float(rec))
+        elif isinstance(rec, str):
+            emit(name, "gauge", dict(labels, value=rec), 1.0)
+        elif isinstance(rec, dict) and rec.get("type") == "counter":
+            emit(name, "counter", labels, float(rec.get("value", 0.0)))
+        elif isinstance(rec, dict) and rec.get("type") == "gauge":
+            emit(name, "gauge", labels, float(rec.get("value", 0.0)))
+        elif isinstance(rec, dict) and rec.get("type") == "histogram":
+            pname = _prom_name(name)
+            if pname not in typed:
+                typed[pname] = "histogram"
+                lines.append(f"# TYPE {pname} histogram")
+            cum = 0
+            for b, c in zip(list(rec.get("buckets", [])) + ["+Inf"],
+                            rec.get("counts", [])):
+                cum += c
+                lines.append(f"{pname}_bucket"
+                             f"{_prom_labels(dict(labels, le=str(b)))}"
+                             f" {cum}")
+            lines.append(f"{pname}_sum{_prom_labels(labels)} "
+                         f"{float(rec.get('sum', 0.0))!r}")
+            lines.append(f"{pname}_count{_prom_labels(labels)} "
+                         f"{int(rec.get('count', 0))}")
+        elif isinstance(rec, dict):
+            for sub in sorted(rec):
+                v = rec[sub]
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                emit(f"{name}.{sub}", "gauge", labels, float(v))
+    return "\n".join(lines) + "\n"
+
+
 def gather_snapshots(snap: Dict[str, Dict[str, Any]]
                      ) -> List[Dict[str, Dict[str, Any]]]:
     """All processes' snapshots, in process order (multi-process pods;

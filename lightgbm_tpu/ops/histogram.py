@@ -98,6 +98,15 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
     n, f = binned.shape
     c = vals.shape[1] * (num_slots if slot is not None else 1)
 
+    # static FLOP/byte accounting from the TRACED shapes (obs/flops.py;
+    # a Python side effect, so it fires once per fresh trace and costs
+    # nothing at runtime — the comm.py trick applied to compute)
+    from ..obs.flops import hist_flops_bytes, note_traced
+    note_traced("hist", *hist_flops_bytes(
+        n, f, num_bins, channels=c,
+        binned_itemsize=getattr(binned.dtype, "itemsize", 1)),
+        phase="grow")
+
     # Pad the bin axis to a multiple of 64 so the [blk, F, Bp] -> [blk, F*Bp]
     # merge is a free relayout (the minor dim tiles onto the 128-lane
     # registers).  Measured on v5e: B=63 unpadded costs 14.3 ms/pass vs

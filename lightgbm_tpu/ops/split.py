@@ -335,6 +335,12 @@ def find_best_split(hist: jax.Array, total: jax.Array, num_bin: jax.Array,
     out_lo/out_hi: scalar allowed output range of this leaf (monotone)
     """
     f, b, _ = hist.shape
+    # static FLOP/byte note from the traced shapes (obs/flops.py): one
+    # candidate leaf's scan — fires at trace time only; under the
+    # grower's vmap the recorded unit is the per-leaf scan
+    from ..obs.flops import note_traced, split_scan_flops_bytes
+    note_traced("split_scan", *split_scan_flops_bytes(f, b, n_leaves=1),
+                phase="grow")
     parent_out = leaf_output(total[0], total[1], params) \
         if parent_output is None else parent_output
 

@@ -35,6 +35,11 @@ def traverse_tree_binned(binned, split_feature, threshold_bin, default_left,
     gathered group bin is unmapped to the feature's own bin space."""
     trace_event("traverse_tree")
     n = binned.shape[0]
+    from .obs.flops import note_traced, traverse_flops_bytes
+    note_traced("traverse_tree", *traverse_flops_bytes(
+        n, 1, steps, binned.shape[1],
+        binned_itemsize=getattr(binned.dtype, "itemsize", 1)),
+        phase="score", cadence="iter")
     node = jnp.zeros(n, jnp.int32)
 
     def body(_, node):
@@ -119,6 +124,11 @@ def traverse_forest_binned(binned, split_feature, threshold_bin,
     trace_event("forest")
     n = binned.shape[0]
     t = split_feature.shape[0]
+    from .obs.flops import note_traced, traverse_flops_bytes
+    note_traced("forest", *traverse_flops_bytes(
+        n, t, steps, binned.shape[1],
+        binned_itemsize=getattr(binned.dtype, "itemsize", 1)),
+        phase="serve", cadence="iter")
     node = jnp.zeros((n, t), jnp.int32)
     tree_ids = jnp.arange(t, dtype=jnp.int32)[None, :]
 

@@ -82,14 +82,20 @@ class Server:
             min_bucket=cfg.serve_min_bucket,
             verify_artifacts=cfg.serve_verify_artifacts,
             device_binning=cfg.serve_device_binning)
+        # versions EVER activated (not currently registered — unload()
+        # can hide history): gates the perf.forest achieved-rate join,
+        # whose all-time rows/latency counters only describe one model
+        self._versions_loaded = 0
         model_file = model_file or (cfg.input_model or None)
         if booster is not None or model_file or model_str:
             self.registry.load(model_file=model_file,
                                model_str=model_str, booster=booster)
+            self._versions_loaded = 1
         elif cfg.resume and cfg.output_model:
             # serve the newest complete snapshot of a (possibly still
             # running) training job
             self.registry.load_snapshot(cfg.output_model)
+            self._versions_loaded = 1
         self.breaker = ServeBreaker(
             failures=cfg.serve_breaker_failures,
             cooldown_ms=cfg.serve_breaker_cooldown_ms,
@@ -111,17 +117,41 @@ class Server:
             metrics=self.metrics, tracer=self.tracer)
         self._t0 = time.time()
         self._closed = False
+        # flight recorder (obs/blackbox.py): per-batch records, dumped
+        # on a batch failure; None (zero-cost) unless telemetry_blackbox
+        from ..obs.blackbox import maybe_recorder
+        self.recorder = maybe_recorder(
+            cfg, default_path="lgbtpu_serve_blackbox.jsonl",
+            meta={"surface": "serve"})
 
     # -- batch execution (worker thread) -----------------------------------
     def _predict_batch(self, rows: np.ndarray) -> Tuple[np.ndarray, dict]:
         from ..utils import faultinject
-        faultinject.check("serve_batch")   # chaos site (soak harness)
-        served = self.registry.current()   # resolved per batch: requests
-        # already in this batch finish on it even if a reload lands now
-        if self.config.serve_device_binning and served.engine is not None:
-            out = served.engine.predict(rows, device_binning=True)
-        else:
-            out = served.booster.predict(rows)
+        t0 = time.perf_counter() if self.recorder is not None else 0.0
+        try:
+            faultinject.check("serve_batch")   # chaos site (soak harness)
+            served = self.registry.current()   # resolved per batch:
+            # requests already in this batch finish on it even if a
+            # reload lands now
+            if self.config.serve_device_binning \
+                    and served.engine is not None:
+                out = served.engine.predict(rows, device_binning=True)
+            else:
+                out = served.booster.predict(rows)
+        except Exception as e:
+            if self.recorder is not None:
+                # the batch-failure path is a flight-recorder trigger:
+                # the dump carries the trailing per-batch records the
+                # breaker/outage post-mortem needs
+                self.recorder.record(event="batch_error",
+                                     rows=int(len(rows)),
+                                     error=f"{type(e).__name__}: {e}")
+                self.recorder.dump("serve_batch_failure")
+            raise
+        if self.recorder is not None:
+            self.recorder.record(rows=int(len(rows)),
+                                 model_version=served.version,
+                                 dur_s=round(time.perf_counter() - t0, 6))
         return np.asarray(out), {"model_version": served.version}
 
     # -- client surface ----------------------------------------------------
@@ -173,6 +203,7 @@ class Server:
         except BaseException:
             self.metrics.counter("serve.reload_failures").inc()
             raise
+        self._versions_loaded += 1
         Log.info(f"serve: activated model {version}")
         return version
 
@@ -249,6 +280,36 @@ class Server:
             engine = self.registry.current().engine
             if engine is not None:
                 snap["serve.engine"] = engine.compile_stats()
+                # perf.* roofline gauges for the forest-traversal path
+                # (obs/flops.py formulas + obs/attrib.py peak table):
+                # static per-row accounting always; achieved rates when
+                # latency history exists.  serve.latency is
+                # client-observed (queueing included), so the achieved
+                # FLOP/s is a LOWER bound on the device rate.
+                from ..obs.attrib import config_peaks, roofline
+                from ..obs.flops import traverse_flops_bytes
+                fl, hb = traverse_flops_bytes(
+                    1, len(engine.trees), engine._steps,
+                    engine.num_features, binned_itemsize=4)
+                snap["perf.forest.flops_per_row"] = fl
+                snap["perf.forest.hbm_bytes_per_row"] = hb
+                # achieved rates join the CURRENT engine's per-row
+                # accounting with the ALL-TIME rows/latency counters —
+                # only meaningful while one model version has ever
+                # served (after a hot swap the counters mix models, so
+                # the join degrades to the static per-row keys above)
+                rows = snap.get("serve.rows", {}).get("value", 0.0)
+                lat = snap.get("serve.latency") or {}
+                secs = float(lat.get("sum", 0.0)) if lat.get("count") \
+                    else 0.0
+                pf, pb = config_peaks(self.config)
+                # intensity/bound are per-row ratios — always valid
+                for k, v in roofline(fl, hb, 0, pf, pb).items():
+                    snap[f"perf.forest.{k}"] = v
+                if self._versions_loaded <= 1:
+                    for k, v in roofline(fl * rows, hb * rows, secs,
+                                         pf, pb).items():
+                        snap[f"perf.forest.{k}"] = v
         except NoModelError:
             pass
         # process-wide compile accounting (utils/compile_cache.py): the
@@ -263,6 +324,8 @@ class Server:
             return
         self._closed = True
         self.batcher.close()
+        if self.recorder is not None:
+            self.recorder.close()
         if self.obs is not None:
             self.obs.finish()
 
@@ -303,9 +366,14 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
 
         def _send(self, code: int, payload: dict,
                   headers: Optional[dict] = None) -> None:
-            body = json.dumps(payload).encode()
+            self._send_text(code, json.dumps(payload),
+                            "application/json", headers)
+
+        def _send_text(self, code: int, text: str, content_type: str,
+                       headers: Optional[dict] = None) -> None:
+            body = text.encode("utf-8")
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
@@ -313,7 +381,9 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            from urllib.parse import parse_qs, urlparse
+            u = urlparse(self.path)
+            if u.path == "/healthz":
                 h = server.health()
                 # readiness semantics for load balancers: 200 only
                 # while NEW traffic should be routed here; a draining
@@ -321,8 +391,17 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 # health() computes "ready" — route on it so code and
                 # body can never disagree
                 self._send(200 if h["ready"] else 503, h)
-            elif self.path == "/metrics":
-                self._send(200, server.metrics_snapshot())
+            elif u.path == "/metrics":
+                snap = server.metrics_snapshot()
+                if parse_qs(u.query).get("format", [""])[0] == "prom":
+                    # Prometheus text exposition (obs/metrics.py),
+                    # covering the perf.* gauges and serve histograms
+                    from ..obs.metrics import prometheus_text
+                    self._send_text(
+                        200, prometheus_text(snap),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._send(200, snap)
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 

@@ -386,12 +386,20 @@ class Watchdog:
         self.timeout_s = float(timeout_s)
         self.label = label
         self.file = file
+        self._bb_timer = None
 
     def __enter__(self) -> "Watchdog":
         if self.timeout_s > 0:
             faulthandler.dump_traceback_later(
                 self.timeout_s, repeat=True,
                 file=self.file if self.file is not None else sys.stderr)
+            # a wedge is also a flight-recorder trigger: alongside the
+            # faulthandler stack dump, dump every live blackbox ring
+            # (obs/blackbox.py) so the post-mortem carries the last K
+            # iteration records, not just stacks.  No-op (None) when no
+            # recorder is live — the telemetry_blackbox=false fast path.
+            from ..obs.blackbox import watchdog_timer
+            self._bb_timer = watchdog_timer(self.timeout_s, self.label)
             from .log import Log
             Log.debug(f"watchdog armed ({self.timeout_s:g}s) around "
                       f"{self.label or 'blocking call'}")
@@ -400,6 +408,9 @@ class Watchdog:
     def __exit__(self, *exc) -> None:
         if self.timeout_s > 0:
             faulthandler.cancel_dump_traceback_later()
+            if self._bb_timer is not None:
+                self._bb_timer.cancel()
+                self._bb_timer = None
 
 
 # ---------------------------------------------------------------------------
