@@ -461,6 +461,28 @@ def child_extras() -> None:
     except Exception as e:
         _record_point("serve", error=f"{type(e).__name__}: {e}"[:200])
 
+    # fused device-resident serve path (ISSUE 10): the same drive with
+    # serve_device_binning — one jitted bin/traverse/accumulate program,
+    # one sync per batch.  Folds into extras as serve_device_rows_per_s
+    # / serve_device_p99_ms, gated by tools/bench_diff.py next to the
+    # host-accumulation numbers above
+    try:
+        import bench_serve
+        spd = bench_serve.run_bench(
+            duration_s=2.0 if cpu else 4.0, clients=4,
+            rows_per_request=64,
+            n_train=5_000 if cpu else 50_000, device_binning=True)
+        _record_point("serve_device", cpu=cpu,
+                      **{k: v for k, v in spd.items()
+                         if k in ("rows_per_s", "p50_ms", "p99_ms",
+                                  "requests", "batch_occupancy_mean",
+                                  "compile_bound", "fused_batches",
+                                  "host_fallback_batches",
+                                  "table_bytes")})
+    except Exception as e:
+        _record_point("serve_device",
+                      error=f"{type(e).__name__}: {e}"[:200])
+
     # comm wire bytes per boosting iteration (obs/comm.py static model,
     # same math the telemetry counters use at train time): the in-flight
     # number arXiv:1706.08359 instruments to validate scaling — one

@@ -307,10 +307,28 @@ _PARAMS: Dict[str, tuple] = {
     # retries for TRANSIENT device errors during a serve batch
     # (utils/resilience.py classifier; programming errors never retry)
     "serve_retries": (int, 2, []),
-    # opt-in: bin raw rows ON-DEVICE in f32 fused with the traversal —
-    # higher throughput, but rows tying a split threshold within f32
-    # rounding may bin differently from the exact (host f64) path
+    # opt-in device-resident fast path: bin + traverse + accumulate +
+    # objective transform run as ONE jitted program per (model,
+    # row-bucket) — the only host<->device sync per batch is the final
+    # score fetch.  Approximate vs the exact host path: rows tying a
+    # split threshold within f32 rounding may bin differently, and leaf
+    # values accumulate in f32 (tree order).  The engine self-check
+    # gates the path; a parity failure demotes the model to the host
+    # walk (serve.host_fallback_batches) instead of refusing traffic
     "serve_device_binning": (bool, False, []),
+    # pack the serve engine's flattened node tables to the narrowest
+    # dtypes the model allows (thresholds uint8/uint16 by bin count,
+    # children/features by node/feature count): ~4x smaller HBM/VMEM
+    # footprint per resident model — the headroom multi-model
+    # co-hosting spends.  Decisions are identical either way
+    "serve_packed_tables": (bool, True, []),
+    # co-hosting cap: max model versions kept device-resident in the
+    # serving registry; loading past it evicts the oldest non-current
+    # version (hot-swap/shadow versions below the cap serve without
+    # re-upload or re-trace).  The current version and the incoming
+    # load are never evicted, so a shadow load may exceed the cap by
+    # one until the next load/swap.  0 = unlimited
+    "serve_max_resident": (int, 0, []),
     "serve_host": (str, "127.0.0.1", []),
     "serve_port": (int, 7070, []),
     # default per-request deadline (ms): requests are failed-fast at
@@ -621,6 +639,9 @@ class Config:
             raise ValueError("serve_breaker_cooldown_ms must be > 0 "
                              "(set serve_breaker_failures=0 to disable "
                              "the breaker)")
+        if self.serve_max_resident < 0:
+            raise ValueError("serve_max_resident must be >= 0 "
+                             "(0 = unlimited resident versions)")
         if self.serve_breaker_failures < 0:
             raise ValueError("serve_breaker_failures must be >= 0 "
                              "(0 disables the breaker)")

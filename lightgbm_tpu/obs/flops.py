@@ -152,6 +152,42 @@ def traverse_flops_bytes(n_rows: int, n_trees: int, steps: int,
             + int(n_rows) * int(n_feat) * int(binned_itemsize))
 
 
+def device_bin_flops_bytes(n_rows: int, n_feat: int,
+                           thr_bins: int) -> Tuple[int, int]:
+    """On-device model-derived binning (predict_device
+    ``bin_rows_device*``): one compare+accumulate per (row, feature,
+    threshold-table slot) — the searchsorted-as-comparison-sum.
+    Bytes: raw f32 rows read + threshold tables read + binned write
+    (the binned tensor stays in registers when fused ahead of the
+    traversal, but the write is counted as the op's result)."""
+    n, f, b = int(n_rows), int(n_feat), int(thr_bins)
+    flops = 2 * n * f * b
+    hbm = n * f * 4 + f * b * 4 + n * f * 4
+    return flops, hbm
+
+
+def fused_forest_flops_bytes(n_rows: int, n_trees: int, steps: int,
+                             n_feat: int, thr_bins: int,
+                             num_class: int = 1,
+                             table_itemsize: int = 4) -> Tuple[int, int]:
+    """One fused serve batch (predict_device.fused_forest_predict):
+    on-device binning + whole-forest traversal + tree-order leaf-value
+    accumulation (gather + multiply + add per (row, tree)) + objective
+    transform (~4 elementwise ops per output).  ``table_itemsize`` is
+    the PACKED node-table element width (serve_packed_tables), which
+    scales the traversal's gather bytes; the final ``[rows, out]``
+    score is the only tensor that crosses back to the host."""
+    n, t, k = int(n_rows), int(n_trees), max(1, int(num_class))
+    bf, bb = device_bin_flops_bytes(n, n_feat, thr_bins)
+    per_level = n * t * int(steps)
+    tf = TRAVERSE_OPS_PER_STEP * per_level
+    tb = (TRAVERSE_BYTES_PER_STEP * per_level
+          * int(table_itemsize)) // 4
+    af = 3 * n * t + 4 * n * k
+    ab = n * t * 4 + 2 * n * k * 4
+    return bf + tf + af, bb + tb + ab
+
+
 def train_hist_flops_per_iter(n_rows: int, n_feat: int, num_bins: int,
                               num_leaves: int) -> float:
     """Useful histogram FLOPs per boosting iteration: one C=3 full-N

@@ -103,6 +103,44 @@ def forest_trace_count() -> int:
     return _FOREST_TRACES[0]
 
 
+def _forest_walk(binned, split_feature, threshold_bin, default_left,
+                 left_child, right_child, na_bin, is_cat_node, cat_index,
+                 cat_table, steps: int):
+    """Shared traced body of the whole-forest traversal (no counters —
+    callers own trace accounting).  The node tables may arrive in
+    PACKED narrow dtypes (serve/engine.py ``serve_packed_tables``:
+    thresholds uint8/uint16 by bin count, children int8/int16 by node
+    count); every gathered value is widened to int32 before compare /
+    index use, so packing shrinks HBM traffic without touching the
+    decision arithmetic."""
+    n = binned.shape[0]
+    t = split_feature.shape[0]
+    node = jnp.zeros((n, t), jnp.int32)
+    tree_ids = jnp.arange(t, dtype=jnp.int32)[None, :]
+
+    def body(_, node):
+        internal = node >= 0
+        nid = jnp.maximum(node, 0)
+        f = split_feature[tree_ids, nid].astype(jnp.int32)     # [N, T]
+        v = jnp.take_along_axis(binned, f, axis=1) \
+            .astype(jnp.int32)                                 # [N, T]
+        cat = is_cat_node[tree_ids, nid]
+        nb = na_bin[f]
+        is_na = (nb >= 0) & (v == nb) & (~cat)
+        ci = cat_index[tree_ids, nid].astype(jnp.int32)
+        rank = jnp.where(cat, cat_table[ci, v].astype(jnp.int32), v)
+        go_left = jnp.where(
+            is_na, default_left[tree_ids, nid],
+            rank <= threshold_bin[tree_ids, nid].astype(jnp.int32))
+        nxt = jnp.where(go_left,
+                        left_child[tree_ids, nid].astype(jnp.int32),
+                        right_child[tree_ids, nid].astype(jnp.int32))
+        return jnp.where(internal, nxt, node)
+
+    node = lax.fori_loop(0, steps, body, node)
+    return (~node).astype(jnp.int32)
+
+
 def traverse_forest_binned(binned, split_feature, threshold_bin,
                            default_left, left_child, right_child, na_bin,
                            is_cat_node, cat_index, cat_table, *, steps: int):
@@ -129,26 +167,9 @@ def traverse_forest_binned(binned, split_feature, threshold_bin,
         n, t, steps, binned.shape[1],
         binned_itemsize=getattr(binned.dtype, "itemsize", 1)),
         phase="serve", cadence="iter")
-    node = jnp.zeros((n, t), jnp.int32)
-    tree_ids = jnp.arange(t, dtype=jnp.int32)[None, :]
-
-    def body(_, node):
-        internal = node >= 0
-        nid = jnp.maximum(node, 0)
-        f = split_feature[tree_ids, nid]                       # [N, T]
-        v = jnp.take_along_axis(binned, f, axis=1)             # [N, T]
-        cat = is_cat_node[tree_ids, nid]
-        nb = na_bin[f]
-        is_na = (nb >= 0) & (v == nb) & (~cat)
-        rank = jnp.where(cat, cat_table[cat_index[tree_ids, nid], v], v)
-        go_left = jnp.where(is_na, default_left[tree_ids, nid],
-                            rank <= threshold_bin[tree_ids, nid])
-        nxt = jnp.where(go_left, left_child[tree_ids, nid],
-                        right_child[tree_ids, nid])
-        return jnp.where(internal, nxt, node)
-
-    node = lax.fori_loop(0, steps, body, node)
-    return (~node).astype(jnp.int32)
+    return _forest_walk(binned, split_feature, threshold_bin,
+                        default_left, left_child, right_child, na_bin,
+                        is_cat_node, cat_index, cat_table, steps)
 
 
 def bin_rows_device(x, thresholds, na_bin, zero_bin):
@@ -169,3 +190,109 @@ def bin_rows_device(x, thresholds, na_bin, zero_bin):
                    axis=-1).astype(jnp.int32)
     fallback = jnp.where(na_bin >= 0, na_bin, zero_bin)[None, :]
     return jnp.where(isnan, fallback, bins)
+
+
+def bin_rows_device_full(x, thresholds, na_bin, zero_bin, cat_values,
+                         cat_len):
+    """On-device model-derived binning covering BOTH feature kinds.
+
+    Numerical features bin exactly like :func:`bin_rows_device`.
+    Categorical features (``cat_len[f] > 0``) reproduce the host
+    ``engine.bin_rows`` mapping in integer-exact arithmetic:
+    ``iv = trunc(x)`` (NaN/inf -> -1, the reference
+    CategoricalDecision input mapping), position = count of known
+    categories < iv, and the position is kept only when the category
+    at it matches ``iv`` — otherwise the unseen-category sentinel bin
+    ``cat_len[f]``.  ``cat_values`` [F, C] holds each categorical
+    feature's sorted known categories as f32 (padded +inf; exact for
+    |category| < 2^24 — the engine refuses device binning beyond
+    that).  f32 rounding can only move a NUMERICAL threshold tie; the
+    categorical compare is integer-exact."""
+    xf = x.astype(jnp.float32)
+    isnan = jnp.isnan(xf)
+    bins = jnp.sum(xf[:, :, None] > thresholds[None, :, :],
+                   axis=-1).astype(jnp.int32)
+    fallback = jnp.where(na_bin >= 0, na_bin, zero_bin)[None, :]
+    bins = jnp.where(isnan, fallback, bins)
+    if cat_values.shape[1] > 0:
+        iv = jnp.where(jnp.isfinite(xf), jnp.trunc(xf), -1.0)
+        pos = jnp.sum(cat_values[None, :, :] < iv[:, :, None],
+                      axis=-1).astype(jnp.int32)
+        posc = jnp.clip(pos, 0, jnp.maximum(cat_len - 1, 0)[None, :])
+        feat_ids = jnp.arange(xf.shape[1], dtype=jnp.int32)[None, :]
+        hit = cat_values[feat_ids, posc]                    # [N, F]
+        cat_bin = jnp.where(hit == iv, posc, cat_len[None, :])
+        bins = jnp.where((cat_len > 0)[None, :], cat_bin, bins)
+    return bins
+
+
+# ---------------------------------------------------------------------------
+# Fused device-resident serve path (one jit: bin -> traverse -> accumulate
+# -> transform; serve/engine.py fused_predict)
+# ---------------------------------------------------------------------------
+
+# traces of the fused serve program, counted at trace time like
+# _FOREST_TRACES — tests and tools/check_retraces.py pin the budget
+_FUSED_TRACES = [0]
+
+
+def fused_trace_count() -> int:
+    """Number of times ``fused_forest_predict`` has been traced (==
+    compiled) in this process."""
+    return _FUSED_TRACES[0]
+
+
+def fused_forest_predict(x, thresholds, na_bin, zero_bin, cat_values,
+                         cat_len, split_feature, threshold_bin,
+                         default_left, left_child, right_child,
+                         is_cat_node, cat_index, cat_table, leaf_value,
+                         tree_weight, avg_denom, *, steps: int,
+                         num_class: int, transform):
+    """The device-resident serve fast path: raw rows [N, F] -> final
+    scores, ONE program.
+
+    Bins on device (:func:`bin_rows_device_full`, f32), walks the whole
+    forest (:func:`_forest_walk` over the packed SoA tables), gathers
+    each tree's leaf value (``leaf_value`` [T, L] f32), multiplies by
+    ``tree_weight`` [T] (DART/RF weights), and accumulates per class
+    IN TREE ORDER with a sequential ``fori_loop`` — the accumulation
+    order is part of the path's parity contract (serve/engine.py
+    ``_fused_reference`` recomputes exactly these f32 ops on the host
+    for the self-check).  ``avg_denom`` (f32 scalar, 1.0 when not
+    averaging) applies RF output averaging; ``transform`` (static; a
+    shared per-objective-config callable, None = raw) applies the
+    objective's output conversion.  The caller fetches ONLY the
+    returned [N] / [N, num_class] scores — the single host<->device
+    sync of a fused serve batch (tools/sync_allowlist.txt)."""
+    _FUSED_TRACES[0] += 1
+    trace_event("serve_fused")
+    n, f = x.shape
+    t = split_feature.shape[0]
+    from .obs.flops import fused_forest_flops_bytes, note_traced
+    note_traced("serve_fused", *fused_forest_flops_bytes(
+        n, t, steps, f, thresholds.shape[1], num_class,
+        table_itemsize=getattr(threshold_bin.dtype, "itemsize", 4)),
+        phase="serve", cadence="iter")
+    binned = bin_rows_device_full(x, thresholds, na_bin, zero_bin,
+                                  cat_values, cat_len)
+    leaves = _forest_walk(binned, split_feature, threshold_bin,
+                          default_left, left_child, right_child, na_bin,
+                          is_cat_node, cat_index, cat_table, steps)
+    tree_ids = jnp.arange(t, dtype=jnp.int32)[None, :]
+    vals = leaf_value[tree_ids, leaves]                        # [N, T]
+    # barrier: keep the weight multiply a distinct op from the loop's
+    # adds so XLA cannot FMA-contract across them — the host oracle
+    # recomputes mul-then-add as separate IEEE f32 ops
+    prods = lax.optimization_barrier(vals * tree_weight[None, :])
+    k = max(1, int(num_class))
+    score = jnp.zeros((n, k), jnp.float32)
+
+    def body(ti, s):
+        return s.at[:, ti % k].add(prods[:, ti])
+
+    score = lax.fori_loop(0, t, body, score)
+    score = score / avg_denom
+    out = score if k > 1 else score[:, 0]
+    if transform is not None:
+        out = transform(out)
+    return out

@@ -6,10 +6,15 @@ dedicated-GBDT-inference-engine literature (arXiv:2011.02022 SoA tree
 layouts, arXiv:1706.08359 batched device traversal):
 
 - ``engine``    compiled predictor: the ensemble flattened ONCE into
-                SoA device arrays, rows binned into model-derived bin
-                space, whole-forest traversal under a bucketed compile
-                cache (batch sizes round up to power-of-two buckets so
-                XLA compiles are bounded by log2(max_batch)).
+                packed SoA device arrays, rows binned into
+                model-derived bin space, whole-forest traversal under a
+                bucketed compile cache (batch sizes round up to
+                power-of-two buckets so XLA compiles are bounded by
+                log2(max_batch)); under ``serve_device_binning`` the
+                whole batch — bin, traverse, accumulate, transform —
+                runs as ONE jitted device-resident program with a
+                single final-score fetch (docs/Serving.md
+                "Device-resident fast path").
 - ``batcher``   micro-batching queue: a worker thread coalesces
                 concurrent requests under ``serve_max_batch`` /
                 ``serve_max_wait_ms`` with a bounded queue and explicit

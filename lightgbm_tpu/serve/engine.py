@@ -32,6 +32,33 @@ by (model fingerprint, padded batch bucket):
   in the same order, as ``Booster.predict``, so engine scores (and the
   serve path built on them) are byte-identical to the reference
   predictor, linear trees and DART/RF tree weights included.
+- **Fused device-resident fast path** (``fused_predict``, the
+  ``serve_device_binning`` serving mode): binning, the whole-forest
+  traversal, the tree-order leaf-value accumulation AND the objective
+  output transform run as ONE jitted program
+  (``predict_device.fused_forest_predict``) so the only host<->device
+  sync per batch is the final ``[rows, out]`` score fetch — the
+  ``[rows, trees]`` leaf-id fetch plus host f64 accumulation of the
+  exact path collapses to a single small transfer (PROFILE.md measured
+  ~67 ms per blocking round trip on a tunneled v5e; the sync count,
+  not the traversal math, caps ``serve_rows_per_s``).  The fused
+  accumulation is f32 in tree order; its parity contract is
+  :meth:`_fused_reference` — a host recomputation of exactly those f32
+  ops — enforced byte-for-byte by :meth:`self_check` on probe rows
+  where f32 and f64 binning provably agree.  Models the fused program
+  cannot represent (linear-leaf outputs need raw-feature host math;
+  categories beyond f32's exact integer range) serve via the host
+  paths and are counted in ``serve.host_fallback_batches``.
+- **Packed tables** (``serve_packed_tables``): the flattened node
+  tables pack to the narrowest dtype the model allows — thresholds to
+  uint8/uint16 by bin count, children/features/cat-indices by
+  node/feature count — shrinking the per-model HBM footprint ~4x
+  (gathered values widen to int32 on device, so decisions are
+  identical), which is the headroom multi-model co-hosting spends.
+  Node/leaf/step axes pad to the shared pow2 policy
+  (``utils/shapes.py`` bucket_nodes/bucket_leaf_slots/bucket_steps),
+  so co-hosted versions of one model family land on identical SoA
+  shapes and share every compiled serve trace.
 """
 
 from __future__ import annotations
@@ -42,12 +69,14 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.shapes import bucket_rows, round_up_pow2
+from ..utils.shapes import (bucket_bins, bucket_leaf_slots, bucket_nodes,
+                            bucket_rows, bucket_steps)
 
 _CAT_BIT = 1
 _DEFAULT_LEFT_BIT = 2
 _MISSING_SHIFT = 2
 _ALWAYS_LEFT = np.int32(1 << 30)   # stump sentinel threshold: rank <= this
+_F32_EXACT_INT = float(1 << 24)    # |ints| below this are f32-exact
 
 
 class EngineUnsupported(ValueError):
@@ -137,6 +166,7 @@ def _feature_tables(trees, num_features: int) -> List[_FeatureTable]:
 # compile-cache entries — the model arrays travel as call arguments, so
 # the cache key is (shapes, steps), never the model content
 _shared_traverse = None
+_shared_fused = None
 
 
 def _traverse_jit():
@@ -147,6 +177,56 @@ def _traverse_jit():
         _shared_traverse = jax.jit(traverse_forest_binned,
                                    static_argnames=("steps",))
     return _shared_traverse
+
+
+def _fused_jit():
+    global _shared_fused
+    if _shared_fused is None:
+        import jax
+        from ..predict_device import fused_forest_predict
+        _shared_fused = jax.jit(
+            fused_forest_predict,
+            static_argnames=("steps", "num_class", "transform"))
+    return _shared_fused
+
+
+# objective output transforms, canonicalized so CO-HOSTED model versions
+# share fused traces: ``transform`` is a STATIC jit argument (hashed by
+# identity), and two boosters of one family carry two distinct-but-equal
+# objective instances — keying the transform by (class, output-relevant
+# params) hands every equal-config objective the SAME callable, hence
+# the same trace.  The cached callable binds the class's unbound
+# ``convert_output`` to a minimal shim carrying only the params the
+# conversions read (``self.sigmoid``, objectives.py) — never the
+# objective instance itself, whose training-side label/weight arrays
+# must not be pinned process-wide by a serve-path cache.
+_TRANSFORM_CACHE: Dict[tuple, object] = {}
+_TRANSFORM_LOCK = threading.Lock()
+
+
+class _TransformSelf:
+    """Stand-in ``self`` for a cached output transform."""
+
+    __slots__ = ("sigmoid",)
+
+    def __init__(self, sigmoid: float):
+        self.sigmoid = sigmoid
+
+
+def _transform_for(objective):
+    if objective is None:
+        return None
+    sigmoid = float(getattr(objective, "sigmoid", 0.0) or 0.0)
+    key = (type(objective).__module__, type(objective).__qualname__,
+           sigmoid)
+    with _TRANSFORM_LOCK:
+        fn = _TRANSFORM_CACHE.get(key)
+        if fn is None:
+            import functools
+            fn = functools.partial(type(objective).convert_output,
+                                   _TransformSelf(sigmoid))
+            _TRANSFORM_CACHE[key] = fn
+    return fn
 
 
 class PredictorEngine:
@@ -161,7 +241,7 @@ class PredictorEngine:
                  num_features: int, objective=None,
                  average_output: bool = False, *,
                  max_batch: Optional[int] = None, min_bucket: int = 16,
-                 fingerprint: Optional[str] = None):
+                 fingerprint: Optional[str] = None, packed: bool = True):
         import jax.numpy as jnp
 
         self.trees = list(trees)
@@ -172,6 +252,7 @@ class PredictorEngine:
         self.average_output = bool(average_output)
         self.max_batch = int(max_batch) if max_batch else None
         self.min_bucket = max(1, int(min_bucket))
+        self.packed = bool(packed)
         if self.max_batch is not None:
             self.min_bucket = min(self.min_bucket, self.max_batch)
         if self.num_features < 1:
@@ -182,16 +263,66 @@ class PredictorEngine:
         self.fingerprint = fingerprint or self._fingerprint()
         self._lock = threading.Lock()
         self._buckets_seen: Dict[int, int] = {}
+        self._fused_buckets: Dict[int, int] = {}
 
         d = self._dev = {}
-        for name in ("split_feature", "threshold_bin", "left_child",
-                     "right_child", "cat_index"):
-            d[name] = jnp.asarray(getattr(self, "_" + name), jnp.int32)
+        packed_arrays = self._packed_host_arrays()
+        for name, arr in packed_arrays.items():
+            d[name] = jnp.asarray(arr)
         d["default_left"] = jnp.asarray(self._default_left, jnp.bool_)
         d["is_cat_node"] = jnp.asarray(self._is_cat_node, jnp.bool_)
-        d["cat_table"] = jnp.asarray(self._cat_table, jnp.int32)
         d["na_bin"] = jnp.asarray(self._na_bin, jnp.int32)
         self._bin_dev = None               # lazy device-binning tables
+
+        # fused-path availability + parity contract pieces: the f32
+        # leaf table and weights the device will gather (and the host
+        # reference oracle replays), the RF averaging denominator, the
+        # canonicalized objective transform
+        self._leaf_f32 = np.zeros(
+            (len(self.trees), self._leaf_slots), np.float32)
+        if len(self.trees):
+            self._leaf_f32[:, :self.leaf_values.shape[1]] = \
+                self.leaf_values.astype(np.float32)
+        # the ONE f32 weight vector both the device program and its
+        # host parity oracle read — a single array so they can never
+        # drift apart
+        self._w32 = np.asarray(
+            [self.tree_weights[t] if t < len(self.tree_weights) else 1.0
+             for t in range(len(self.trees))], np.float32)
+        t1, k = len(self.trees), self.num_class
+        self._avg_denom = float(max(t1 // k, 1)) \
+            if (self.average_output and t1 > 0) else 1.0
+        self._transform = _transform_for(objective)
+        self.fused_reason: Optional[str] = None
+        if not self.trees:
+            self.fused_reason = "model has no trees"
+        elif any(t.is_linear for t in self.trees):
+            self.fused_reason = ("linear-leaf outputs need raw-feature "
+                                 "host math")
+        elif self._device_bin_err:
+            self.fused_reason = self._device_bin_err
+        self._fused_dev = None             # lazy leaf/weight upload
+
+        # per-model device-resident footprint — the number an operator
+        # sizes serve_max_resident from, so EVERYTHING resident counts:
+        # packed node tables, leaf values, tree weights, and the fused
+        # path's binning tables (f32 [F, padded-B] thresholds +
+        # [F, padded-C] categories + two [F] int32 vectors)
+        F = self.num_features
+        bin_table_bytes = 0
+        if self._device_bin_err is None:
+            pb, pc = self._bin_table_widths()
+            bin_table_bytes = F * pb * 4 + F * pc * 4 + 2 * F * 4
+        self.table_bytes = int(
+            sum(a.nbytes for a in packed_arrays.values())
+            + self._default_left.nbytes + self._is_cat_node.nbytes
+            + self._na_bin.nbytes + self._leaf_f32.nbytes
+            + 4 * len(self.trees) + bin_table_bytes)
+
+    @property
+    def fused_ok(self) -> bool:
+        """Whether :meth:`fused_predict` can serve this model."""
+        return self.fused_reason is None
 
     def _traverse(self, binned):
         d = self._dev
@@ -202,11 +333,71 @@ class PredictorEngine:
             d["cat_table"], steps=self._steps)
 
     # -- construction ------------------------------------------------------
+    @staticmethod
+    def _uint_dtype(max_val: int):
+        """Narrowest unsigned dtype holding [0, max_val]."""
+        if max_val <= np.iinfo(np.uint8).max:
+            return np.uint8
+        if max_val <= np.iinfo(np.uint16).max:
+            return np.uint16
+        return np.int32
+
+    @staticmethod
+    def _int_dtype(min_val: int, max_val: int):
+        """Narrowest signed dtype holding [min_val, max_val]."""
+        for dt in (np.int8, np.int16):
+            ii = np.iinfo(dt)
+            if ii.min <= min_val and max_val <= ii.max:
+                return dt
+        return np.int32
+
+    def _packed_host_arrays(self) -> Dict[str, np.ndarray]:
+        """The node tables at their device dtypes (serve_packed_tables:
+        narrowest dtype the model's bin/node/feature counts allow;
+        ``packed=False`` keeps everything int32).  The stump sentinel
+        threshold re-encodes as the packed dtype's max — every real
+        rank is strictly below it, so ``rank <= sentinel`` stays
+        always-true.  Values widen back to int32 after each device
+        gather (predict_device._forest_walk), so packing changes HBM
+        bytes, never decisions."""
+        out: Dict[str, np.ndarray] = {}
+        if not self.packed:
+            out["split_feature"] = self._split_feature
+            out["threshold_bin"] = self._threshold_bin
+            out["left_child"] = self._left_child
+            out["right_child"] = self._right_child
+            out["cat_index"] = self._cat_index
+            out["cat_table"] = self._cat_table
+            return out
+        M = self._split_feature.shape[1] if self._split_feature.size \
+            else 1
+        L = self._leaf_slots
+        max_rank = max([t.num_bins - 1 for t in self.tables] + [1])
+        thr_dt = self._uint_dtype(max_rank + 1)   # +1: sentinel slot
+        sentinel = np.iinfo(thr_dt).max
+        out["threshold_bin"] = np.where(
+            self._threshold_bin == _ALWAYS_LEFT, sentinel,
+            self._threshold_bin).astype(thr_dt)
+        child_dt = self._int_dtype(-L, M - 1)
+        out["left_child"] = self._left_child.astype(child_dt)
+        out["right_child"] = self._right_child.astype(child_dt)
+        out["split_feature"] = self._split_feature.astype(
+            self._uint_dtype(max(self.num_features - 1, 0)))
+        out["cat_index"] = self._cat_index.astype(
+            self._uint_dtype(max(len(self._cat_table) - 1, 0)))
+        out["cat_table"] = self._cat_table.astype(np.uint8)
+        return out
+
     def _build_soa(self) -> None:
         trees = self.trees
         T = len(trees)
-        M = max([t.num_nodes() for t in trees] + [1])
-        L = max([t.num_leaves for t in trees] + [1])
+        # node/leaf slots pad to the shared pow2 policy so co-hosted
+        # versions of one model family (hot-swap / shadow) land on
+        # identical SoA shapes and reuse each other's compiled serve
+        # programs; padded slots cost table memory only
+        M = bucket_nodes(max([t.num_nodes() for t in trees] + [1]))
+        L = bucket_leaf_slots(max([t.num_leaves for t in trees] + [1]))
+        self._leaf_slots = L
         self._split_feature = np.zeros((T, M), np.int32)
         self._threshold_bin = np.zeros((T, M), np.int32)
         self._default_left = np.zeros((T, M), bool)
@@ -260,7 +451,26 @@ class PredictorEngine:
                 # threshold_bin stays 0: go left iff rank <= 0
         self._cat_table = (np.stack(cat_rows) if cat_rows
                            else np.zeros((1, 1), np.int32))
-        self._steps = round_up_pow2(depth)
+        self._steps = bucket_steps(depth)
+        # host->device transfer dtype for host-binned batches: bins are
+        # bounded by the model's own table sizes, so the [N, F] binned
+        # matrix usually crosses the wire as uint8
+        max_bin = max([tab.num_bins - 1 for tab in self.tables] + [1])
+        self._bin_dtype = self._uint_dtype(max_bin) if self.packed \
+            else np.int32
+        # device binning needs every categorical value f32-exact (the
+        # fused path compares trunc(f32 x) against an f32 category
+        # table); a model using categories at/above 2^24 serves via the
+        # host paths instead
+        self._device_bin_err: Optional[str] = None
+        for f, tab in enumerate(self.tables):
+            if tab.kind == "cat" and len(tab.cats) \
+                    and float(np.abs(tab.cats).max()) >= _F32_EXACT_INT:
+                self._device_bin_err = (
+                    f"feature {f} uses categories beyond f32's exact "
+                    f"integer range (>= 2^24); device binning would "
+                    "misroute them")
+                break
 
     def _fingerprint(self) -> str:
         h = hashlib.sha256()
@@ -308,21 +518,37 @@ class PredictorEngine:
         return bucket_rows(n, min_bucket=self.min_bucket,
                            cap=self.max_batch)
 
+    def _bin_table_widths(self) -> Tuple[int, int]:
+        """Padded (threshold, category) table widths: pow2 via the
+        shared policy — the widths are part of the fused program's
+        signature, and a co-hosted version with a few more distinct
+        thresholds must not re-trace."""
+        b = bucket_bins(
+            max([len(t.thresholds) for t in self.tables] + [1]))
+        c = max([len(t.cats) for t in self.tables] + [0])
+        return b, (bucket_bins(c, floor=4) if c else 0)
+
     def _device_bin_tables(self):
         import jax.numpy as jnp
         if self._bin_dev is None:
-            B = max([len(t.thresholds) for t in self.tables] + [1])
-            thr = np.full((self.num_features, B), np.inf, np.float32)
-            zero_bin = np.zeros(self.num_features, np.int32)
+            if self._device_bin_err:
+                raise EngineUnsupported(self._device_bin_err)
+            F = self.num_features
+            B, C = self._bin_table_widths()
+            thr = np.full((F, B), np.inf, np.float32)
+            zero_bin = np.zeros(F, np.int32)
+            cat_vals = np.full((F, C), np.inf, np.float32)
+            cat_len = np.zeros(F, np.int32)
             for f, tab in enumerate(self.tables):
                 if tab.kind == "num":
                     thr[f, :len(tab.thresholds)] = tab.thresholds
                     zero_bin[f] = np.searchsorted(tab.thresholds, 0.0,
                                                   "left")
-                elif tab.kind == "cat":
-                    raise EngineUnsupported(
-                        "device binning supports numerical features only")
-            self._bin_dev = (jnp.asarray(thr), jnp.asarray(zero_bin))
+                elif tab.kind == "cat" and len(tab.cats):
+                    cat_vals[f, :len(tab.cats)] = tab.cats
+                    cat_len[f] = len(tab.cats)
+            self._bin_dev = (jnp.asarray(thr), jnp.asarray(zero_bin),
+                             jnp.asarray(cat_vals), jnp.asarray(cat_len))
         return self._bin_dev
 
     # -- traversal ---------------------------------------------------------
@@ -346,14 +572,17 @@ class PredictorEngine:
                 self._buckets_seen[bucket] = \
                     self._buckets_seen.get(bucket, 0) + 1
             if device_binning:
-                thr, zero_bin = self._device_bin_tables()
-                from ..predict_device import bin_rows_device
+                thr, zero_bin, cat_vals, cat_len = \
+                    self._device_bin_tables()
+                from ..predict_device import bin_rows_device_full
                 xpad = np.zeros((bucket, self.num_features), np.float32)
                 xpad[:len(sub)] = sub
-                binned = bin_rows_device(jax.numpy.asarray(xpad), thr,
-                                         self._dev["na_bin"], zero_bin)
+                binned = bin_rows_device_full(
+                    jax.numpy.asarray(xpad), thr, self._dev["na_bin"],
+                    zero_bin, cat_vals, cat_len)
             else:
-                pad = np.zeros((bucket, self.num_features), np.int32)
+                pad = np.zeros((bucket, self.num_features),
+                               self._bin_dtype)
                 pad[:len(sub)] = self.bin_rows(sub)
                 binned = jax.numpy.asarray(pad)
             # the serve hot path's ONE device fetch: leaf ids are the
@@ -361,6 +590,98 @@ class PredictorEngine:
             out = jax.device_get(self._traverse(binned))
             chunks.append(np.asarray(out[:len(sub)], np.int32))
         return np.concatenate(chunks, axis=0)
+
+    # -- fused device-resident path ----------------------------------------
+    def _fused_dev_arrays(self):
+        import jax.numpy as jnp
+        if self._fused_dev is None:
+            self._fused_dev = (
+                jnp.asarray(self._leaf_f32),
+                jnp.asarray(self._w32),
+                jnp.asarray(np.float32(self._avg_denom)))
+        return self._fused_dev
+
+    def _fused_call(self, xdev, transform):
+        d = self._dev
+        thr, zero_bin, cat_vals, cat_len = self._device_bin_tables()
+        leaf_value, tree_weight, avg_denom = self._fused_dev_arrays()
+        return _fused_jit()(
+            xdev, thr, d["na_bin"], zero_bin, cat_vals, cat_len,
+            d["split_feature"], d["threshold_bin"], d["default_left"],
+            d["left_child"], d["right_child"], d["is_cat_node"],
+            d["cat_index"], d["cat_table"], leaf_value, tree_weight,
+            avg_denom, steps=self._steps, num_class=self.num_class,
+            transform=transform)
+
+    def fused_predict(self, x: np.ndarray,
+                      raw_score: bool = False) -> np.ndarray:
+        """Full prediction through the ONE-jit device-resident program
+        (bin -> traverse -> accumulate -> transform on device): [n, F]
+        raw floats -> final f32 scores, with a SINGLE host<->device
+        sync per bucket chunk — the final score fetch.  Raises
+        :class:`EngineUnsupported` when :attr:`fused_reason` is set
+        (linear trees, f32-inexact categories); callers fall back to
+        the host paths (serve/server.py counts
+        ``serve.host_fallback_batches``).  Accumulation is f32 in tree
+        order — the contract :meth:`_fused_reference` replays and
+        :meth:`self_check` enforces; vs the exact host path the
+        difference is the f64->f32 accumulation rounding, documented
+        as ``serve_device_binning``'s accepted cost."""
+        import jax
+        if self.fused_reason is not None:
+            raise EngineUnsupported(self.fused_reason)
+        x = np.asarray(x, np.float64)
+        n = len(x)
+        k = self.num_class
+        if n == 0:
+            return np.zeros((0, k) if k > 1 else (0,), np.float32)
+        transform = None if raw_score else self._transform
+        cap = self._bucket(n)
+        chunks = []
+        for lo in range(0, n, cap):
+            sub = x[lo:lo + cap]
+            bucket = self._bucket(len(sub))
+            with self._lock:
+                self._fused_buckets[bucket] = \
+                    self._fused_buckets.get(bucket, 0) + 1
+            xpad = np.zeros((bucket, self.num_features), np.float32)
+            xpad[:len(sub)] = sub
+            scores = self._fused_call(jax.numpy.asarray(xpad), transform)
+            # the fused serve hot path's ONE device fetch: the final
+            # [rows, out] scores (tools/sync_allowlist.txt)
+            out = jax.device_get(scores)
+            chunks.append(np.asarray(out[:len(sub)]))
+        return np.concatenate(chunks, axis=0)
+
+    def _fused_reference(self, x: np.ndarray,
+                         raw_score: bool = False) -> np.ndarray:
+        """Host oracle for the fused path's parity contract: the SAME
+        f32 float ops, in the same order, over leaves from the host
+        tree walk — f32 leaf-value gather, f32 weight multiply, f32
+        tree-order accumulation, f32 RF averaging, then the shared
+        objective transform.  ``self_check`` compares
+        :meth:`fused_predict` against this byte-for-byte on rows where
+        f32 and f64 binning provably agree, so the comparison isolates
+        the device binning + traversal + accumulation."""
+        x = np.asarray(x, np.float64)
+        n = len(x)
+        k = self.num_class
+        T = len(self.trees)
+        if n == 0 or T == 0:
+            return np.zeros((0, k) if k > 1 else (0,), np.float32)
+        leaves = np.stack([t.predict_leaf(x) for t in self.trees],
+                          axis=1).astype(np.int32)
+        vals = self._leaf_f32[np.arange(T)[None, :], leaves]
+        prods = vals * self._w32[None, :]
+        score = np.zeros((n, k), np.float32)
+        for ti in range(T):
+            score[:, ti % k] += prods[:, ti]
+        score = score / np.float32(self._avg_denom)
+        out = score if k > 1 else score[:, 0]
+        if not raw_score and self._transform is not None:
+            import jax.numpy as jnp
+            out = np.asarray(self._transform(jnp.asarray(out)))
+        return out
 
     # -- scoring -----------------------------------------------------------
     def raw_scores(self, x: np.ndarray, t0: int = 0,
@@ -433,6 +754,15 @@ class PredictorEngine:
         exact = self.bin_rows(x)
         ok = np.ones(len(x), bool)
         for f, tab in enumerate(self.tables):
+            if tab.kind == "cat" and len(tab.cats):
+                # integer-exact on device IF trunc(f32 x) == trunc(f64
+                # x): only f32 rounding of the raw value can diverge
+                v = x[:, f]
+                iv64 = np.where(np.isfinite(v), v, -1.0).astype(np.int64)
+                vf = v.astype(np.float32)
+                iv32 = np.where(np.isfinite(vf), np.trunc(vf), -1.0)
+                ok &= iv32 == iv64
+                continue
             if tab.kind != "num" or not len(tab.thresholds):
                 continue
             v = x[:, f]
@@ -472,7 +802,20 @@ class PredictorEngine:
         compiled artifact disagrees with the model it was built from
         (a flattening bug, a device numeric surprise) — callers fall
         back to the host walk rather than serve wrong predictions
-        (serve/registry.py)."""
+        (serve/registry.py).
+
+        With ``device_binning`` and a fused-capable model the probe
+        additionally gates the FUSED one-jit path
+        (:meth:`fused_predict`): its scores must byte-match
+        :meth:`_fused_reference` — the host replay of the same f32
+        tree-order accumulation — on the consensus rows.  A failure
+        here demotes the model to the host walk
+        (``serve.host_fallback_batches``) instead of refusing
+        traffic."""
+        from ..utils import faultinject
+        # chaos site (tools/soak_serve.py): a failing self-check must
+        # DEMOTE the engine to the host walk, never drop requests
+        faultinject.check("serve_self_check")
         cands = self._probe_candidates()
         if not cands or not self.trees:
             return True
@@ -491,37 +834,76 @@ class PredictorEngine:
                 return False
             if device_binning:
                 mask = self._f32_consensus_mask(probe)
-                if mask.any() and not np.array_equal(
-                        self.raw_scores(probe[mask],
-                                        device_binning=True),
-                        host[mask]):
-                    return False
+                if mask.any():
+                    if not np.array_equal(
+                            self.raw_scores(probe[mask],
+                                            device_binning=True),
+                            host[mask]):
+                        return False
+                    if self.fused_reason is None and not np.array_equal(
+                            self.fused_predict(probe[mask]),
+                            self._fused_reference(probe[mask])):
+                        return False
         return True
 
     # -- introspection -----------------------------------------------------
+    def per_row_flops_bytes(self, fused: bool = False) -> Tuple[int, int]:
+        """Static (flops, hbm_bytes) per served row — the numbers the
+        serve ``/metrics`` roofline join (``perf.forest.*``) uses, kept
+        truthful per path: the fused formula covers on-device binning +
+        traversal + accumulation + transform at the PACKED table
+        itemsize; the host-binned path covers the traversal only."""
+        from ..obs.flops import (fused_forest_flops_bytes,
+                                 traverse_flops_bytes)
+        if fused and self.fused_reason is None:
+            # padded table width — the comparisons the hardware runs
+            B, _ = self._bin_table_widths()
+            return fused_forest_flops_bytes(
+                1, len(self.trees), self._steps, self.num_features, B,
+                self.num_class,
+                table_itemsize=self._dev["threshold_bin"].dtype.itemsize)
+        return traverse_flops_bytes(
+            1, len(self.trees), self._steps, self.num_features,
+            binned_itemsize=np.dtype(self._bin_dtype).itemsize)
+
     def compile_stats(self) -> dict:
         """Bucketed-compile-cache ledger: buckets used (with hit
-        counts), the bound on distinct traversal shapes, and the
-        process-wide forest trace counter
-        (``predict_device.forest_trace_count``)."""
-        from ..predict_device import forest_trace_count
+        counts, host-binned and fused paths separately), the bound on
+        distinct traversal shapes, the process-wide trace counters
+        (``predict_device.forest_trace_count`` /
+        ``fused_trace_count``), fused availability and the packed
+        node-table footprint."""
+        from ..predict_device import forest_trace_count, fused_trace_count
         with self._lock:
             buckets = dict(sorted(self._buckets_seen.items()))
-        cap = self.max_batch or max(list(buckets) + [self.min_bucket])
+            fused_buckets = dict(sorted(self._fused_buckets.items()))
+        cap = self.max_batch or max(list(buckets) + list(fused_buckets)
+                                    + [self.min_bucket])
         import math
         bound = int(math.ceil(math.log2(max(cap, 2)))) + 1
         return {"fingerprint": self.fingerprint, "buckets": buckets,
+                "fused_buckets": fused_buckets,
                 "max_compiles_bound": bound,
                 "forest_traces_process": forest_trace_count(),
+                "fused_traces_process": fused_trace_count(),
+                "fused": self.fused_reason is None,
+                "fused_reason": self.fused_reason,
+                "packed": self.packed,
+                "table_bytes": self.table_bytes,
+                "threshold_dtype":
+                    str(self._dev["threshold_bin"].dtype),
+                "child_dtype": str(self._dev["left_child"].dtype),
                 "steps": self._steps, "num_trees": len(self.trees)}
 
     @classmethod
     def from_booster(cls, booster, *, max_batch: Optional[int] = None,
-                     min_bucket: int = 16) -> "PredictorEngine":
+                     min_bucket: int = 16,
+                     packed: bool = True) -> "PredictorEngine":
         """Flatten a ``Booster`` (live or loaded from a model file)."""
         return cls(booster.trees, booster.tree_weights,
                    booster._num_tree_per_iteration,
                    booster.num_feature(),
                    objective=getattr(booster, "objective", None),
                    average_output=booster._average_output,
-                   max_batch=max_batch, min_bucket=min_bucket)
+                   max_batch=max_batch, min_bucket=min_bucket,
+                   packed=packed)

@@ -65,19 +65,30 @@ class ModelRegistry:
     def __init__(self, *, max_batch: Optional[int] = None,
                  min_bucket: int = 16, build_engine: bool = True,
                  verify_artifacts: bool = True,
-                 device_binning: bool = False):
+                 device_binning: bool = False, packed: bool = True,
+                 max_resident: int = 0):
         self._models: Dict[str, ServedModel] = {}
         self._current: Optional[ServedModel] = None
         self._lock = threading.Lock()
         self._next_version = 1
         self._engine_opts = {"max_batch": max_batch,
-                             "min_bucket": min_bucket}
+                             "min_bucket": min_bucket, "packed": packed}
         self._build_engine = build_engine
         self._verify = verify_artifacts
         # the server will serve via the f32 device-binning path
         # (serve_device_binning): self-checks must verify THAT path,
         # not just the host-binned one
         self._device_binning = device_binning
+        # co-hosting cap (serve_max_resident): every registered version
+        # keeps its engine — packed SoA tables — device-resident, so a
+        # swap back to it needs no re-upload and (shapes matching,
+        # utils/shapes.py pow2 SoA padding) no re-trace.  Past the cap,
+        # loading evicts the oldest non-current version; the current
+        # version and the load in hand are never candidates, so a
+        # shadow load can exceed the cap by ONE until the next load or
+        # swap (refusing it would be worse than a transient +1).
+        # 0 = unlimited
+        self._max_resident = max(0, int(max_resident))
 
     # -- loading -----------------------------------------------------------
     def load(self, model_file: Optional[str] = None,
@@ -202,6 +213,18 @@ class ModelRegistry:
             self._models[version] = served
             if activate or self._current is None:
                 self._current = served
+            if self._max_resident > 0:
+                # evict oldest non-current versions past the residency
+                # cap — the bound on co-hosted HBM footprint.  The
+                # just-registered version is never an eviction
+                # candidate: a shadow load (activate=False) at the cap
+                # must displace an OLDER version, not itself
+                others = sorted(
+                    (m for m in self._models.values()
+                     if m is not self._current and m is not served),
+                    key=lambda m: m.loaded_at)
+                while len(self._models) > self._max_resident and others:
+                    self._models.pop(others.pop(0).version, None)
         return version
 
     def load_snapshot(self, output_model: str,

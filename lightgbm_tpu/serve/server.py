@@ -13,7 +13,12 @@ model version — which itself routes through the bucketed
 to a direct ``Booster.predict`` call on the same rows, micro-batch
 coalescing included (elementwise routing + per-row accumulation make
 batch composition invisible; tests/test_serve.py proves it across the
-objective/feature matrix).
+objective/feature matrix).  With ``serve_device_binning`` the batch
+instead rides the engine's fused device-resident program
+(``fused_predict``: one jit, one sync — docs/Serving.md
+"Device-resident fast path"); models the fused path cannot serve (or
+that failed the self-check gate) demote to the host walk, counted in
+``serve.host_fallback_batches``.
 
 ``start_http`` exposes the same Server over a stdlib-only
 ``ThreadingHTTPServer``:
@@ -81,7 +86,9 @@ class Server:
             max_batch=cfg.serve_max_batch,
             min_bucket=cfg.serve_min_bucket,
             verify_artifacts=cfg.serve_verify_artifacts,
-            device_binning=cfg.serve_device_binning)
+            device_binning=cfg.serve_device_binning,
+            packed=cfg.serve_packed_tables,
+            max_resident=cfg.serve_max_resident)
         # versions EVER activated (not currently registered — unload()
         # can hide history): gates the perf.forest achieved-rate join,
         # whose all-time rows/latency counters only describe one model
@@ -133,9 +140,22 @@ class Server:
             served = self.registry.current()   # resolved per batch:
             # requests already in this batch finish on it even if a
             # reload lands now
-            if self.config.serve_device_binning \
-                    and served.engine is not None:
-                out = served.engine.predict(rows, device_binning=True)
+            if self.config.serve_device_binning:
+                eng = served.engine
+                if eng is not None and eng.fused_reason is None:
+                    # device-resident fast path: ONE jitted
+                    # bin->traverse->accumulate->transform program, one
+                    # host<->device sync (the final score fetch)
+                    out = eng.fused_predict(rows)
+                    self.metrics.counter("serve.fused_batches").inc()
+                else:
+                    # demoted (failed self-check discarded the engine)
+                    # or fused-incapable (linear trees, f32-inexact
+                    # categories): the always-correct host walk serves
+                    # — slower, never wrong, never refused
+                    self.metrics.counter(
+                        "serve.host_fallback_batches").inc()
+                    out = served.booster.predict(rows)
             else:
                 out = served.booster.predict(rows)
         except Exception as e:
@@ -287,10 +307,14 @@ class Server:
                 # client-observed (queueing included), so the achieved
                 # FLOP/s is a LOWER bound on the device rate.
                 from ..obs.attrib import config_peaks, roofline
-                from ..obs.flops import traverse_flops_bytes
-                fl, hb = traverse_flops_bytes(
-                    1, len(engine.trees), engine._steps,
-                    engine.num_features, binned_itemsize=4)
+                # per-path static accounting (obs/flops.py): the fused
+                # one-jit program bins/accumulates/transforms on device,
+                # so its per-row flops/bytes differ from the host-binned
+                # traversal — the ledger note inside the fused trace and
+                # this join use the SAME formula, keeping perf.forest.*
+                # truthful for whichever path serves
+                fl, hb = engine.per_row_flops_bytes(
+                    fused=self.config.serve_device_binning)
                 snap["perf.forest.flops_per_row"] = fl
                 snap["perf.forest.hbm_bytes_per_row"] = hb
                 # achieved rates join the CURRENT engine's per-row

@@ -59,7 +59,7 @@ ENV_VAR = "LGBM_TPU_FAULTS"
 
 KNOWN_SITES = ("device_claim", "collective", "snapshot_write",
                "snapshot_kill", "nan_grads", "serve_batch",
-               "serve_reload")
+               "serve_reload", "serve_self_check")
 
 
 class InjectedFault(RuntimeError):

@@ -21,6 +21,16 @@ extracted so every layer buckets the same way:
   and snapping explicit odd values keeps the super-step trace family
   closed (K is a structural constant of the trace, it cannot be made
   dynamic the way the leaf budget can).
+- **serve SoA dimensions** (node slots, leaf slots, traversal steps):
+  power-of-two with floors (:func:`bucket_nodes`,
+  :func:`bucket_leaf_slots`, :func:`bucket_steps`) so two co-hosted
+  model versions of one family (hot-swap / shadow, serve/registry.py)
+  land on IDENTICAL SoA shapes and share every compiled serve trace —
+  a retrained model whose deepest tree moved from 13 to 15 nodes must
+  not re-trace the fused serve program.  Node/leaf padding costs
+  memory only (padded slots are never gathered); the steps floor costs
+  up to ``floor - 1`` no-op level walks for very shallow forests
+  (:func:`bucket_steps` documents the tradeoff).
 
 The retrace-budget lint (tools/check_retraces.py) pins the trace
 counts this policy produces; changing a bucket boundary is a conscious
@@ -49,10 +59,16 @@ def round_up_pow2(x: int) -> int:
     return p
 
 
+def _pow2_floor(n: int, floor: int) -> int:
+    """THE bucketing rule every dimension policy below delegates to:
+    pow2 with a floor.  Change it here, nowhere else."""
+    return max(int(floor), round_up_pow2(max(int(n), 1)))
+
+
 def bucket_rows(n: int, min_bucket: int = 16, cap: int | None = None) -> int:
     """Pow2 row bucket with a floor (and an optional pow2'd cap) —
     the serve/engine.py batch policy, shared."""
-    b = max(int(min_bucket), round_up_pow2(max(int(n), 1)))
+    b = _pow2_floor(n, min_bucket)
     if cap is not None:
         b = min(b, round_up_pow2(int(cap)))
     return b
@@ -64,7 +80,42 @@ def bucket_leaves(num_leaves: int, floor: int = LEAF_BUCKET_FLOOR) -> int:
     31 / 40 / 63 -> 64; 127 -> 128; 255 -> 256.  The grower exits its
     while_loop on the ACTUAL budget, so the padded slots only cost
     state memory, never semantics (grower.py ``max_leaves``)."""
-    return max(int(floor), round_up_pow2(int(num_leaves)))
+    return _pow2_floor(num_leaves, floor)
+
+
+def bucket_nodes(n: int, floor: int = 16) -> int:
+    """Padded per-tree node-slot count for the serve SoA tables: pow2
+    with a floor.  Padded node rows are never reached by traversal
+    (children pad to -1), so the cost is table memory only."""
+    return _pow2_floor(n, floor)
+
+
+def bucket_leaf_slots(n: int, floor: int = 8) -> int:
+    """Padded per-tree leaf-slot count for the serve leaf-value table:
+    pow2 with a floor; padded slots hold 0.0 and are never gathered."""
+    return _pow2_floor(n, floor)
+
+
+def bucket_bins(n: int, floor: int = 16) -> int:
+    """Padded device bin-table width (per-feature threshold slots /
+    known-category slots, serve/engine.py ``_device_bin_tables``): pow2
+    with a floor.  Pad slots hold +inf, so every comparison against
+    them is false — a retrained co-hosted version whose threshold
+    count moved from 40 to 55 must not re-trace the fused serve
+    program."""
+    return _pow2_floor(n, floor)
+
+
+def bucket_steps(depth: int, floor: int = 8) -> int:
+    """Padded traversal step count (forest max depth): pow2 with a
+    floor.  Finished rows carry their leaf id unchanged through the
+    padded levels, so extra steps change cost, never results.  The
+    floor keeps co-hosted versions whose depths jitter in the common
+    shallow range (3..8) on ONE trace; the price is up to ``floor - 1``
+    no-op level walks for very shallow forests (a depth-2 forest walks
+    8 levels instead of 2) — accepted because sub-floor forests are
+    tiny workloads and the trace-sharing win compounds per version."""
+    return _pow2_floor(depth, floor)
 
 
 def snap_split_batch(k: int) -> int:

@@ -1,0 +1,522 @@
+"""Device-resident serving fast path (ISSUE 10, docs/Serving.md
+"Device-resident fast path").
+
+The acceptance bar: the fused one-jit bin->traverse->accumulate->
+transform program (``PredictorEngine.fused_predict``,
+``predict_device.fused_forest_predict``) does EXACTLY one host<->device
+sync per serve batch (counted-device_get test), its scores byte-match
+the host replay of the same f32 tree-order ops
+(``engine._fused_reference``) on rows where f32 and f64 binning
+provably agree — across the regression/binary/multiclass/categorical/
+EFB/DART/RF matrix — and a failed engine self-check DEMOTES the model
+to the always-correct host walk (``serve.host_fallback_batches``)
+instead of refusing traffic.  Satellites: packed uint8/uint16 node
+tables vs int32 equivalence, zero-row batches, multi-model co-hosting
+(shared traces + residency cap).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.predict_device import forest_trace_count, fused_trace_count
+from lightgbm_tpu.serve import PredictorEngine, Server, start_http
+from lightgbm_tpu.serve.engine import EngineUnsupported
+from lightgbm_tpu.serve.registry import ModelRegistry
+from lightgbm_tpu.utils import faultinject
+
+
+def _data(n=450, f=6, seed=0, nan_frac=0.05, cat_col=None):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, f)
+    if cat_col is not None:
+        x[:, cat_col] = rs.randint(0, 12, n)
+    x[rs.rand(n, f) < nan_frac] = np.nan
+    if cat_col is not None:
+        c = x[:, cat_col]
+        x[:, cat_col] = np.where(np.isnan(c), np.nan, np.abs(c))
+    return x
+
+
+def _train(params, x, y, rounds=6, **kw):
+    ds = lgb.Dataset(x, label=y, **kw)
+    return lgb.train({"verbosity": -1, "num_leaves": 8, **params}, ds,
+                     num_boost_round=rounds)
+
+
+def _fused_matrix():
+    """(tag, booster, test rows) across the fused parity matrix —
+    every objective/feature family the ISSUE names."""
+    rs = np.random.RandomState(7)
+    out = []
+
+    x = _data(seed=1)
+    y = np.where(np.isnan(x[:, 0]), 0.3, x[:, 0] + 0.5 * x[:, 1])
+    out.append(("regression", _train({"objective": "regression"}, x, y),
+                _data(120, seed=11)))
+
+    x = _data(seed=2)
+    y = (np.nan_to_num(x[:, 0]) > 0).astype(np.float64)
+    out.append(("binary", _train({"objective": "binary"}, x, y),
+                _data(120, seed=12)))
+
+    x = _data(seed=3)
+    y = rs.randint(0, 3, len(x)).astype(np.float64)
+    out.append(("multiclass",
+                _train({"objective": "multiclass", "num_class": 3}, x, y),
+                _data(120, seed=13)))
+
+    x = _data(seed=4, cat_col=2)
+    y = (np.nan_to_num(x[:, 2]) % 3 == 0).astype(np.float64)
+    xt = _data(120, seed=14)
+    xt[:, 2] = rs.randint(-2, 16, len(xt)).astype(np.float64)
+    out.append(("categorical",
+                _train({"objective": "binary"}, x, y,
+                       categorical_feature=[2]), xt))
+
+    x = _data(seed=5)
+    y = (np.nan_to_num(x[:, 0]) > 0).astype(np.float64)
+    out.append(("dart", _train({"objective": "binary",
+                                "boosting": "dart"}, x, y),
+                _data(120, seed=15)))
+
+    x = _data(seed=6, nan_frac=0.0)
+    out.append(("rf", _train({"objective": "regression", "boosting": "rf",
+                              "bagging_fraction": 0.7,
+                              "bagging_freq": 1}, x, x[:, 0]),
+                _data(120, seed=16, nan_frac=0.0)))
+
+    # EFB-bundled model (training-side bundling; serving bins raw
+    # features from the model's own thresholds, so EFB must be
+    # invisible to the fused path)
+    n, n_cats = 700, 12
+    dense = rs.randn(n, 3)
+    cat = rs.randint(0, n_cats, n)
+    onehot = np.zeros((n, n_cats))
+    onehot[np.arange(n), cat] = 1.0
+    x = np.column_stack([dense, onehot])
+    y = (dense[:, 0] + (cat % 3 == 0) > 0.5).astype(np.float64)
+    bst = _train({"objective": "binary"}, x, y)
+    assert bst._model.train_set.efb is not None, "EFB did not trigger"
+    d2 = rs.randn(120, 3)
+    c2 = rs.randint(0, n_cats, 120)
+    oh2 = np.zeros((120, n_cats))
+    oh2[np.arange(120), c2] = 1.0
+    out.append(("efb", bst, np.column_stack([d2, oh2])))
+    return out
+
+
+@pytest.fixture(scope="module")
+def fused_matrix():
+    return _fused_matrix()
+
+
+# ---------------------------------------------------------------------------
+# fused parity (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestFusedParity:
+    def test_fused_matches_f32_reference_and_host_walk(self, fused_matrix):
+        """On f32==f64-consensus rows the fused scores byte-match the
+        host replay of the same f32 ops, and track the exact f64 host
+        walk to f32 accumulation rounding."""
+        for tag, bst, xt in fused_matrix:
+            eng = PredictorEngine.from_booster(bst)
+            assert eng.fused_ok, (tag, eng.fused_reason)
+            mask = eng._f32_consensus_mask(np.asarray(xt, np.float64))
+            assert mask.any(), tag
+            rows = xt[mask]
+            got = eng.fused_predict(rows)
+            ref = eng._fused_reference(rows)
+            assert np.array_equal(got, ref), tag
+            host = np.asarray(bst.predict(rows), np.float64)
+            assert np.allclose(np.asarray(got, np.float64), host,
+                               rtol=1e-5, atol=1e-6), tag
+            assert got.dtype == np.float32, tag
+
+    def test_self_check_gates_fused_path(self, fused_matrix):
+        for tag, bst, _ in fused_matrix:
+            eng = PredictorEngine.from_booster(bst)
+            assert eng.self_check(device_binning=True), tag
+
+    def test_raw_score_mode(self):
+        x = _data(seed=21)
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(np.float64)
+        bst = _train({"objective": "binary"}, x, y)
+        eng = PredictorEngine.from_booster(bst)
+        xt = _data(40, seed=22)
+        raw = eng.fused_predict(xt, raw_score=True)
+        ref = eng._fused_reference(xt, raw_score=True)
+        assert np.array_equal(raw, ref)
+        host = bst.predict(xt, raw_score=True)
+        assert np.allclose(np.asarray(raw, np.float64), host,
+                           rtol=1e-5, atol=1e-6)
+
+    def test_linear_trees_fall_back_counted(self):
+        """Linear-leaf models cannot ride the fused program (raw-feature
+        host math): the engine refuses, the server serves the exact
+        host path and counts serve.host_fallback_batches."""
+        x = _data(seed=23, nan_frac=0.0)
+        bst = _train({"objective": "regression", "linear_tree": True},
+                     x, x[:, 0])
+        eng = PredictorEngine.from_booster(bst)
+        assert not eng.fused_ok
+        assert "linear" in eng.fused_reason
+        with pytest.raises(EngineUnsupported):
+            eng.fused_predict(x[:4])
+        srv = Server({"serve_device_binning": True,
+                      "serve_max_wait_ms": 0.0}, booster=bst)
+        try:
+            xt = _data(10, seed=24, nan_frac=0.0)
+            out = srv.predict(xt)
+            assert np.array_equal(out, bst.predict(xt))
+            snap = srv.metrics_snapshot()
+            assert snap["serve.host_fallback_batches"]["value"] >= 1
+            assert "serve.fused_batches" not in snap
+        finally:
+            srv.close()
+
+    def test_default_serving_unchanged_byte_identical(self, fused_matrix):
+        """Without serve_device_binning nothing changes: serve results
+        stay byte-identical to Booster.predict."""
+        tag, bst, xt = fused_matrix[1]
+        srv = Server({"serve_max_wait_ms": 0.0}, booster=bst)
+        try:
+            out = srv.predict(xt)
+            assert np.array_equal(out, bst.predict(xt)), tag
+            snap = srv.metrics_snapshot()
+            assert "serve.fused_batches" not in snap
+            assert "serve.host_fallback_batches" not in snap
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# packed tables (satellite)
+# ---------------------------------------------------------------------------
+
+class TestPackedTables:
+    def test_uint8_tables_for_small_models(self):
+        x = _data(seed=31)
+        bst = _train({"objective": "regression"}, x,
+                     np.nan_to_num(x[:, 0]))
+        eng = PredictorEngine.from_booster(bst)
+        stats = eng.compile_stats()
+        assert stats["packed"] is True
+        assert stats["threshold_dtype"] == "uint8"
+        assert stats["child_dtype"] == "int8"
+        assert eng._bin_dtype == np.uint8
+
+    def test_packed_vs_int32_equivalence(self):
+        """Packed narrow tables must route and score EXACTLY like the
+        int32 build — fused path, host-binned leaf path and predict."""
+        x = _data(500, seed=32, cat_col=3)
+        y = (np.nan_to_num(x[:, 0]) + (np.nan_to_num(x[:, 3]) % 2)
+             > 0.5).astype(np.float64)
+        bst = _train({"objective": "binary"}, x, y, rounds=8,
+                     categorical_feature=[3])
+        packed = PredictorEngine.from_booster(bst, packed=True)
+        plain = PredictorEngine.from_booster(bst, packed=False)
+        assert plain.compile_stats()["threshold_dtype"] == "int32"
+        xt = _data(90, seed=33, cat_col=3)
+        assert np.array_equal(packed.leaf_ids(xt), plain.leaf_ids(xt))
+        assert np.array_equal(packed.predict(xt), plain.predict(xt))
+        assert np.array_equal(packed.predict(xt), bst.predict(xt))
+        assert np.array_equal(packed.fused_predict(xt),
+                              plain.fused_predict(xt))
+        assert packed.table_bytes < plain.table_bytes
+
+    def test_uint16_when_bins_outgrow_uint8(self):
+        rs = np.random.RandomState(34)
+        x = rs.randn(1500, 2)
+        y = x[:, 0] + np.sin(3 * x[:, 0]) + 0.1 * x[:, 1]
+        bst = _train({"objective": "regression", "num_leaves": 31,
+                      "max_bin": 1023}, x, y, rounds=30)
+        eng = PredictorEngine.from_booster(bst)
+        max_bins = max(t.num_bins for t in eng.tables)
+        if max_bins <= 255:
+            pytest.skip(f"model too small to outgrow uint8 ({max_bins})")
+        assert eng.compile_stats()["threshold_dtype"] == "uint16"
+        xt = rs.randn(50, 2)
+        plain = PredictorEngine.from_booster(bst, packed=False)
+        assert np.array_equal(eng.fused_predict(xt),
+                              plain.fused_predict(xt))
+        assert np.array_equal(eng.predict(xt), bst.predict(xt))
+
+
+# ---------------------------------------------------------------------------
+# sync count (satellite: the re-pinned serve hot-path sync)
+# ---------------------------------------------------------------------------
+
+class TestSyncCount:
+    def _counting(self, monkeypatch):
+        import jax
+        calls = []
+        real = jax.device_get
+
+        def counting(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        return calls
+
+    def test_exactly_one_sync_per_fused_batch(self, monkeypatch):
+        x = _data(seed=41)
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(np.float64)
+        bst = _train({"objective": "binary"}, x, y, rounds=11)
+        eng = PredictorEngine.from_booster(bst, max_batch=256)
+        eng.fused_predict(x[:50])              # warm the bucket
+        calls = self._counting(monkeypatch)
+        out = eng.fused_predict(x[:50])
+        assert len(calls) == 1, "fused batch must sync exactly once"
+        assert out.shape == (50,)
+        # above the bucket cap: one sync per max-bucket chunk, never
+        # per row or per tree
+        calls.clear()
+        eng.fused_predict(_data(300, seed=42))
+        assert len(calls) == 2                 # 256 + 44 -> two chunks
+
+    def test_fused_serve_batch_single_sync_e2e(self, monkeypatch):
+        """Through the whole serve stack (batcher worker included): a
+        served batch on the fused path costs exactly one device_get."""
+        x = _data(seed=43)
+        y = np.nan_to_num(x[:, 1])
+        bst = _train({"objective": "regression"}, x, y, rounds=7)
+        srv = Server({"serve_device_binning": True,
+                      "serve_max_wait_ms": 0.0}, booster=bst)
+        try:
+            srv.predict(x[:20])                # warm
+            calls = self._counting(monkeypatch)
+            srv.predict(x[:20])
+            assert len(calls) == 1
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# zero rows (satellite)
+# ---------------------------------------------------------------------------
+
+class TestZeroRowFused:
+    def test_zero_rows_no_device_work(self, monkeypatch):
+        x = _data(seed=51)
+        bst = _train({"objective": "multiclass", "num_class": 3}, x,
+                     np.random.RandomState(0).randint(0, 3, len(x))
+                     .astype(np.float64))
+        eng = PredictorEngine.from_booster(bst)
+        calls = self._count(monkeypatch)
+        before = fused_trace_count()
+        out = eng.fused_predict(np.empty((0, x.shape[1])))
+        assert out.shape == (0, 3)
+        assert out.dtype == np.float32
+        assert fused_trace_count() == before
+        assert not calls
+        single = _data(1, seed=52)
+        assert eng.fused_predict(single).shape == (1, 3)
+
+    def _count(self, monkeypatch):
+        import jax
+        calls = []
+        real = jax.device_get
+        monkeypatch.setattr(
+            jax, "device_get",
+            lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
+        return calls
+
+    def test_zero_rows_through_fused_server(self):
+        x = _data(seed=53)
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(float)
+        bst = _train({"objective": "binary"}, x, y)
+        srv = Server({"serve_device_binning": True}, booster=bst)
+        try:
+            out = srv.predict(np.empty((0, x.shape[1])))
+        finally:
+            srv.close()
+        assert out.shape == (0,)
+
+
+# ---------------------------------------------------------------------------
+# demotion (satellite: failed self-check -> host walk, counted)
+# ---------------------------------------------------------------------------
+
+class TestDemotion:
+    def test_self_check_fault_demotes_to_host_walk(self):
+        x = _data(seed=61)
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(float)
+        bst = _train({"objective": "binary"}, x, y)
+        faultinject.configure("serve_self_check:1")
+        try:
+            srv = Server({"serve_device_binning": True,
+                          "serve_max_wait_ms": 0.0}, booster=bst)
+        finally:
+            faultinject.clear()
+        try:
+            assert srv.registry.current().engine is None
+            xt = _data(15, seed=62)
+            out = srv.predict(xt)
+            # demoted = the EXACT host walk, byte for byte
+            assert np.array_equal(out, bst.predict(xt))
+            snap = srv.metrics_snapshot()
+            assert snap["serve.host_fallback_batches"]["value"] >= 1
+        finally:
+            srv.close()
+
+    def test_registry_discards_engine_on_failed_check(self):
+        x = _data(seed=63)
+        bst = _train({"objective": "regression"}, x,
+                     np.nan_to_num(x[:, 0]))
+        reg = ModelRegistry(device_binning=True)
+        faultinject.configure("serve_self_check:1")
+        try:
+            v = reg.load(booster=bst)
+        finally:
+            faultinject.clear()
+        assert reg.get(v).engine is None
+        # a later load without the fault builds the engine again
+        v2 = reg.load(booster=bst)
+        assert reg.get(v2).engine is not None
+
+    @pytest.mark.slow
+    def test_soak_demotion_never_drops_requests(self):
+        """tools/soak_serve.py chaos window with a failing self-check
+        under serve_device_binning: every request answers (fused or
+        demoted host walk), zero invariant violations."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import soak_serve
+        report = soak_serve.run_soak(
+            duration_s=1.5, clients=3, pool_size=8, max_rows=24,
+            device_binning=True,
+            chaos_spec="serve_self_check:1,serve_batch:1-3")
+        assert report["violations"] == [], report["violations"]
+        assert report["counts"].get("ok", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# co-hosting (tentpole: N resident versions share traces + bounded HBM)
+# ---------------------------------------------------------------------------
+
+class TestCoHosting:
+    def test_second_version_shares_all_fused_traces(self):
+        """Two versions of one model family land on identical padded
+        SoA shapes (utils/shapes.py) — the second serves a mixed batch
+        storm with ZERO fresh fused traces."""
+        x = _data(500, seed=71)
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(float)
+        b1 = _train({"objective": "binary", "max_depth": 4}, x, y,
+                    rounds=9)                  # distinctive T=9 shape
+        b2 = _train({"objective": "binary", "max_depth": 4,
+                     "learning_rate": 0.2}, x, y, rounds=9)
+        reg = ModelRegistry(max_batch=64, device_binning=True)
+        v1 = reg.load(booster=b1)
+        e1 = reg.get(v1).engine
+        for n in (3, 17, 40, 64, 100):
+            # warm every serve program variant over the bucket set:
+            # fused, host-binned traversal (packed-uint8 bins) and
+            # device-binned traversal — b2's load-time self-check may
+            # probe any of them at any bucket
+            e1.fused_predict(x[:n])
+            e1.predict(x[:n])
+            e1.leaf_ids(x[:n], device_binning=True)
+        before = fused_trace_count(), forest_trace_count()
+        v2 = reg.load(booster=b2)
+        e2 = reg.get(v2).engine
+        for n in (3, 17, 40, 64, 100):
+            e2.fused_predict(x[:n])
+        assert (fused_trace_count(), forest_trace_count()) == before, \
+            "co-hosted same-family version must share every serve trace"
+        # both stay resident and serve independently
+        xt = x[:30]
+        assert np.array_equal(e1.fused_predict(xt),
+                              e1._fused_reference(xt))
+        assert np.array_equal(e2.fused_predict(xt),
+                              e2._fused_reference(xt))
+
+    def test_max_resident_evicts_oldest_non_current(self):
+        x = _data(seed=72)
+        y = np.nan_to_num(x[:, 0])
+        boosters = [_train({"objective": "regression",
+                            "learning_rate": 0.1 + 0.05 * i}, x, y,
+                           rounds=3) for i in range(4)]
+        reg = ModelRegistry(max_resident=2, build_engine=False)
+        for i, b in enumerate(boosters):
+            reg.load(booster=b, version=f"v{i + 1}")
+        vs = [v["version"] for v in reg.versions()]
+        assert len(vs) == 2
+        assert "v4" in vs                      # current always kept
+        # a shadow load (activate=False) at the cap displaces an OLDER
+        # version, never itself — the returned id must stay resident
+        shadow = reg.load(booster=boosters[0], version="shadow",
+                          activate=False)
+        assert reg.get(shadow) is not None
+        assert reg.current().version == "v4"
+        assert len(reg.versions()) == 2
+        srv = Server({"serve_max_resident": 2}, booster=boosters[0])
+        try:
+            srv.reload(booster=boosters[1])
+            srv.reload(booster=boosters[2])
+            assert len(srv.registry.versions()) == 2
+        finally:
+            srv.close()
+
+    def test_config_validation(self):
+        from lightgbm_tpu.config import Config
+        assert Config({}).serve_packed_tables is True
+        assert Config({}).serve_max_resident == 0
+        with pytest.raises(ValueError):
+            Config({"serve_max_resident": -1})
+
+
+# ---------------------------------------------------------------------------
+# serve stack e2e on the fused path
+# ---------------------------------------------------------------------------
+
+class TestServerFused:
+    def test_fused_serving_in_process_and_http(self):
+        x = _data(seed=81)
+        y = (np.nan_to_num(x[:, 0]) > 0).astype(float)
+        bst = _train({"objective": "binary"}, x, y)
+        srv = Server({"serve_device_binning": True,
+                      "serve_max_wait_ms": 1.0}, booster=bst)
+        eng = srv.registry.current().engine
+        fe = start_http(srv, port=0)
+        try:
+            xt = _data(37, seed=82)
+            expect = eng.fused_predict(xt)
+            got = srv.predict(xt)
+            assert np.array_equal(got, expect)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fe.port}/predict",
+                data=json.dumps({"rows": xt.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(urllib.request.urlopen(req).read())
+            assert np.array_equal(
+                np.asarray(resp["predictions"], np.float32), expect)
+            snap = srv.metrics_snapshot()
+            assert snap["serve.fused_batches"]["value"] >= 2
+            assert snap["serve.engine"]["fused"] is True
+            assert snap["serve.engine"]["fused_buckets"]
+            assert snap["serve.engine"]["table_bytes"] > 0
+            assert snap["perf.forest.flops_per_row"] > 0
+        finally:
+            fe.close()
+            srv.close()
+
+    def test_perf_forest_keys_track_path(self):
+        """perf.forest.* must reflect the path that actually serves:
+        the fused formula covers binning+accumulate+transform, so its
+        per-row flops exceed the traversal-only host accounting."""
+        x = _data(seed=83)
+        bst = _train({"objective": "regression"}, x,
+                     np.nan_to_num(x[:, 0]))
+        eng = PredictorEngine.from_booster(bst)
+        fl_fused, hb_fused = eng.per_row_flops_bytes(fused=True)
+        fl_host, hb_host = eng.per_row_flops_bytes(fused=False)
+        assert fl_fused > fl_host
+        assert hb_fused != hb_host

@@ -627,8 +627,8 @@ class TestVerifiedArtifacts:
         assert eng.self_check(device_binning=True) is True
         assert eng._f32_consensus_mask(
             np.zeros((4, booster.num_feature()))).all()
-        # categoricals cannot device-bin: the check raises (registry
-        # treats an erroring probe as failed -> host-walk fallback)
+        # categoricals device-bin integer-exactly since ISSUE 10 (the
+        # fused serve path needs them): the check covers that path too
         rs = np.random.RandomState(11)
         x = np.column_stack([rs.randint(0, 4, 200).astype(np.float64),
                              rs.randn(200)])
@@ -639,8 +639,25 @@ class TestVerifiedArtifacts:
                         num_boost_round=4)
         ceng = PredictorEngine.from_booster(cat)
         assert ceng.self_check() is True
+        assert ceng.self_check(device_binning=True) is True
+        # ...but categories beyond f32's exact integer range (>= 2^24)
+        # would misroute in the f32 compare: the check raises (registry
+        # treats an erroring probe as failed -> host-walk fallback)
+        big = np.column_stack([
+            np.repeat([1.0, float(1 << 24) + 2.0], 100), rs.randn(200)])
+        bigm = lgb.train({"objective": "regression", "verbosity": -1,
+                          "num_leaves": 4, "min_data_per_group": 1,
+                          "min_data_in_leaf": 5},
+                         lgb.Dataset(big, label=big[:, 1]
+                                     + (big[:, 0] > 2),
+                                     categorical_feature=[0]),
+                         num_boost_round=4)
+        beng = PredictorEngine.from_booster(bigm)
+        if beng._device_bin_err is None:
+            pytest.skip("model grew no >=2^24 categorical split")
+        assert not beng.fused_ok
         with pytest.raises(EngineUnsupported):
-            ceng.self_check(device_binning=True)
+            beng.self_check(device_binning=True)
 
     def test_empty_sha256_pin_refused(self, tmp_path, booster):
         # an empty pin is an unset deploy-script variable, never a

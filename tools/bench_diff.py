@@ -55,10 +55,11 @@ BUDGET = os.path.join(REPO, "tools", "perf_budget.txt")
 # gate downward, everything else (rates, MFU) upward
 _LOWER_BETTER = re.compile(r"(_ms|compile_s|_seconds)$")
 # extras worth gating by default: primary value, throughput points,
-# serve latency/throughput, mfu
+# serve latency/throughput (host-accumulation AND fused device paths),
+# mfu
 _GATEABLE = re.compile(
-    r"(^value$|_iters_per_sec$|^serve_rows_per_s$|^serve_p\d+_ms$"
-    r"|_mfu$|_compile_s$)")
+    r"(^value$|_iters_per_sec$|^serve(_device)?_rows_per_s$"
+    r"|^serve(_device)?_p\d+_ms$|_mfu$|_compile_s$)")
 _DEFAULT_TOL = {"higher": 0.20, "lower": 0.30}
 
 

@@ -11,9 +11,12 @@ Run standalone::
 
     python tools/bench_serve.py [key=value ...]
       duration_s=3 clients=4 rows_per_request=64 serve_max_batch=1024
-      http=0 n_train=20000 n_feat=28
+      http=0 n_train=20000 n_feat=28 device=0
 
-Prints one JSON line with the measured point.
+``device=1`` measures the fused device-resident path
+(``serve_device_binning``; bench.py folds it in as
+``serve_device_rows_per_s`` / ``serve_device_p99_ms``).  Prints one
+JSON line with the measured point.
 """
 
 from __future__ import annotations
@@ -46,13 +49,21 @@ def build_model(n_train: int = 20000, n_feat: int = 28, seed: int = 0,
 def run_bench(booster=None, duration_s: float = 3.0, clients: int = 4,
               rows_per_request: int = 64, http: bool = False,
               params: dict | None = None, n_train: int = 20000,
-              n_feat: int = 28) -> dict:
-    """Drive the serve stack; returns the measured point as a dict."""
+              n_feat: int = 28, device_binning: bool = False) -> dict:
+    """Drive the serve stack; returns the measured point as a dict.
+
+    ``device_binning=True`` measures the FUSED device-resident path
+    (``serve_device_binning``: one jit, one sync per batch) — reported
+    by bench.py as ``serve_device_rows_per_s`` / ``serve_device_p99_ms``
+    next to the host-accumulation numbers."""
     from lightgbm_tpu.serve import Server, start_http
     if booster is None:
         booster = build_model(n_train=n_train, n_feat=n_feat)
     nf = booster.num_feature()
-    srv = Server(dict(params or {}), booster=booster)
+    srv_params = dict(params or {})
+    if device_binning:
+        srv_params.setdefault("serve_device_binning", True)
+    srv = Server(srv_params, booster=booster)
     fe = start_http(srv, port=0) if http else None
     rs = np.random.RandomState(1)
     pool = rs.randn(4096, nf)
@@ -119,10 +130,19 @@ def run_bench(booster=None, duration_s: float = 3.0, clients: int = 4,
         "clients": clients,
         "rows_per_request": rows_per_request,
         "http": bool(http),
+        "device_binning": bool(device_binning),
         "batch_occupancy_mean": round(occ["sum"] / occ["count"], 4)
         if occ.get("count") else None,
-        "engine_buckets": sorted(int(b) for b in eng.get("buckets", {})),
+        "engine_buckets": sorted(
+            int(b) for b in (eng.get("fused_buckets")
+                             if device_binning else eng.get("buckets"))
+            or {}),
         "compile_bound": eng.get("max_compiles_bound"),
+        "fused_batches": int(snap.get("serve.fused_batches", {})
+                             .get("value", 0)),
+        "host_fallback_batches": int(
+            snap.get("serve.host_fallback_batches", {}).get("value", 0)),
+        "table_bytes": eng.get("table_bytes"),
     }
     return point
 
@@ -131,6 +151,7 @@ def main() -> int:
     kv = dict(tok.split("=", 1) for tok in sys.argv[1:] if "=" in tok)
     serve_params = {k: v for k, v in kv.items()
                     if k.startswith("serve_")}
+    device = kv.get("device", "0") not in ("0", "false", "")
     point = run_bench(
         duration_s=float(kv.get("duration_s", 3.0)),
         clients=int(kv.get("clients", 4)),
@@ -138,8 +159,10 @@ def main() -> int:
         http=kv.get("http", "0") not in ("0", "false", ""),
         params=serve_params,
         n_train=int(kv.get("n_train", 20000)),
-        n_feat=int(kv.get("n_feat", 28)))
-    print(json.dumps({"metric": "serve_rows_per_s", **point}), flush=True)
+        n_feat=int(kv.get("n_feat", 28)),
+        device_binning=device)
+    metric = "serve_device_rows_per_s" if device else "serve_rows_per_s"
+    print(json.dumps({"metric": metric, **point}), flush=True)
     return 0
 
 

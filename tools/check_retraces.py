@@ -146,6 +146,36 @@ def run_matrix() -> Dict[str, int]:
         for n in (3, 5, 17, 30, 64, 100):
             eng.predict(x[:n])
 
+    # 6. fused device-resident serve path (ISSUE 10): ONE jitted
+    #    bin->traverse->accumulate->transform program per (model,
+    #    row-bucket) — a mixed-size batch storm (self-check probe
+    #    included, registry.load runs it) must stay within the pow2
+    #    bucket bound ceil(log2(serve_max_batch)) + 1
+    bf1 = _train(lgb, x, y, num_leaves=8, max_depth=4)
+    bf2 = _train(lgb, x, y, num_leaves=8, max_depth=4,
+                 learning_rate=0.2)
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    reg = ModelRegistry(max_batch=64, device_binning=True)
+    with _Scope("serve_fused", measured):
+        v1 = reg.load(booster=bf1)
+        e1 = reg.get(v1).engine
+        assert e1 is not None and e1.fused_reason is None
+        for n in (3, 5, 17, 30, 64, 100):
+            e1.fused_predict(x[:n])
+
+    # 7. co-hosted second version of the SAME model family: the pow2
+    #    SoA padding (utils/shapes.py bucket_nodes/leaf_slots/steps)
+    #    lands it on identical shapes, so EVERY serve trace — fused
+    #    program, traversal, self-check probe — is already cached.
+    #    check() enforces zero traces here; the budget file carries no
+    #    serve_cohost pins by construction
+    with _Scope("serve_cohost", measured):
+        v2 = reg.load(booster=bf2)
+        e2 = reg.get(v2).engine
+        assert e2 is not None and e2.fused_reason is None
+        for n in (3, 5, 17, 30, 64, 100):
+            e2.fused_predict(x[:n])
+
     # negative control: the SAME sweep unbucketed must blow the budget
     with _Scope("negative_unbucketed", measured):
         for nl in (31, 40, 63):
@@ -199,6 +229,15 @@ def check(measured: Dict[str, int],
     for k in sorted(set(budget) - set(measured)):
         findings.append(f"stale budget entry (scenario no longer "
                         f"produces it): {k} = {budget[k]}")
+    # co-hosting invariant (ISSUE 10): the second model version of one
+    # family must hit the first one's compile-cache entries — ANY trace
+    # during its storm is a shape-sharing regression
+    for k in sorted(measured):
+        if k.startswith("serve_cohost."):
+            findings.append(
+                f"co-hosted model re-traced: {k} = {measured[k]} "
+                "(second version of one model family must share every "
+                "serve trace via the pow2 SoA padding)")
     # the negative control must PROVE the lint catches unbucketed
     # regressions: the same sweep without bucketing has to exceed the
     # bucketed grower budget

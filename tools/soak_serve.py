@@ -73,8 +73,21 @@ def run_soak(duration_s: float = 2.0, clients: int = 4,
              pool_size: int = 24, max_rows: int = 48, seed: int = 0,
              chaos: bool = True, reload_every_s: float = 0.25,
              deadline_ms: float = 2000.0, http: bool = False,
+             device_binning: bool = False,
+             chaos_spec: Optional[str] = None,
              params: Optional[Dict] = None) -> Dict:
-    """One soak run; returns the report dict (see module docstring)."""
+    """One soak run; returns the report dict (see module docstring).
+
+    ``device_binning=True`` serves through the fused device-resident
+    path (``serve_device_binning``) and arms a ``serve_self_check``
+    fault in the chaos window: a reload whose engine self-check fails
+    must DEMOTE that version to the host walk — still answering every
+    request with that version's own exact predictions
+    (``serve.host_fallback_batches`` counts them) — never refuse
+    traffic.  Successful responses must then byte-match EITHER the
+    version's fused-path scores or its host-walk scores (both are
+    sanctioned results of the mode; which one served depends on
+    whether the chaos window demoted that load)."""
     from lightgbm_tpu.serve import (BacklogFull, BatcherClosed,
                                     BatcherDraining, CircuitOpen,
                                     DeadlineExceeded, Server)
@@ -84,14 +97,22 @@ def run_soak(duration_s: float = 2.0, clients: int = 4,
     b1, b2 = build_models(seed)
     pool = _request_pool(pool_size, max_rows, seed)
     # byte-parity oracles, computed OUTSIDE the soak: every ok response
-    # must equal the serving version's own Booster.predict, exactly
-    expected = {"m1": [np.asarray(b1.predict(p)) for p in pool],
-                "m2": [np.asarray(b2.predict(p)) for p in pool]}
+    # must equal the serving version's own Booster.predict (host walk)
+    # — or, under device_binning, its fused-path scores
+    expected = {"m1": [[np.asarray(b1.predict(p))] for p in pool],
+                "m2": [[np.asarray(b2.predict(p))] for p in pool]}
+    if device_binning:
+        from lightgbm_tpu.serve.engine import PredictorEngine
+        for tag, bst in (("m1", b1), ("m2", b2)):
+            ref = PredictorEngine.from_booster(bst, max_batch=64)
+            for i, p in enumerate(pool):
+                expected[tag][i].append(ref.fused_predict(p))
     srv_params = {"serve_max_batch": 64, "serve_max_wait_ms": 1.0,
                   "serve_queue_rows": 256, "serve_retries": 1,
                   "serve_breaker_failures": 3,
                   "serve_breaker_cooldown_ms": 200.0,
-                  "serve_deadline_ms": deadline_ms, "verbosity": -1}
+                  "serve_deadline_ms": deadline_ms, "verbosity": -1,
+                  "serve_device_binning": device_binning}
     srv_params.update(params or {})
     srv = Server(srv_params, booster=b1)
     frontend = start_http(srv, port=0) if http else None
@@ -133,12 +154,18 @@ def run_soak(duration_s: float = 2.0, clients: int = 4,
             k += 1
 
     # -- chaos: windows of transient batch faults + failing reloads
+    # (+ under device_binning: a failing engine self-check, which must
+    # demote that reload to the host walk, not refuse traffic)
+    spec = chaos_spec or ("serve_batch:1-6,serve_reload:1"
+                          + (",serve_self_check:1" if device_binning
+                             else ""))
+
     def chaos_thread():
         while not stop.wait(0.4):
             # the next 6 serve batches fail transiently (retries=1 ->
             # 2 attempts/batch -> 3 failed batches -> breaker opens at
             # threshold 3), and the next reload attempt fails too
-            faultinject.configure("serve_batch:1-6,serve_reload:1")
+            faultinject.configure(spec)
             stop.wait(0.15)
             faultinject.configure(None)
 
@@ -163,7 +190,8 @@ def run_soak(duration_s: float = 2.0, clients: int = 4,
             if tag is None:
                 violate(f"response from unknown model version "
                         f"{fut.info.get('model_version')!r}")
-            elif not np.array_equal(out, expected[tag][i]):
+            elif not any(np.array_equal(out, e)
+                         for e in expected[tag][i]):
                 violate(f"PARITY violation on pool[{i}] "
                         f"(version {fut.info.get('model_version')})")
 
@@ -223,7 +251,8 @@ def run_soak(duration_s: float = 2.0, clients: int = 4,
             if tag is None:
                 violate(f"response from unknown model version "
                         f"{resp.get('model_version')!r}")
-            elif not np.array_equal(got, expected[tag][i]):
+            elif not any(np.array_equal(got, e)
+                         for e in expected[tag][i]):
                 violate(f"PARITY violation on pool[{i}] over HTTP "
                         f"(version {resp.get('model_version')})")
 
@@ -291,11 +320,13 @@ def run_soak(duration_s: float = 2.0, clients: int = 4,
         "recovered": recovered,
         "drain": drain,
         "breaker": breaker_end,
+        "device_binning": bool(device_binning),
         "metrics": {k: snap[k] for k in
                     ("serve.requests", "serve.errors", "serve.rejected",
                      "serve.deadline_shed", "serve.deadline_rejected",
                      "serve.breaker_opens", "serve.breaker_rejected",
-                     "serve.reload_failures") if k in snap},
+                     "serve.reload_failures", "serve.fused_batches",
+                     "serve.host_fallback_batches") if k in snap},
         "violations": violations,
     }
     if frontend is not None:
@@ -315,7 +346,8 @@ def main(argv) -> int:
         chaos=kv.get("chaos", "1") not in ("0", "false"),
         reload_every_s=float(kv.get("reload_every_s", 0.25)),
         deadline_ms=float(kv.get("deadline_ms", 2000.0)),
-        http=kv.get("http", "0") not in ("0", "false"))
+        http=kv.get("http", "0") not in ("0", "false"),
+        device_binning=kv.get("device", "0") not in ("0", "false"))
     print(json.dumps(report, indent=1, default=str))
     if report["violations"]:
         print(f"SOAK FAILED: {len(report['violations'])} violation(s)",
