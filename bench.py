@@ -483,6 +483,27 @@ def child_extras() -> None:
         _record_point("serve_device",
                       error=f"{type(e).__name__}: {e}"[:200])
 
+    # continual-pipeline microbench (ISSUE 11, pipeline/continual.py):
+    # two fault-free generations of the train->publish->gate->promote
+    # loop against a live in-process serving registry under client
+    # traffic.  The gated numbers are chunk-arrival-to-serving lag
+    # (continual_freshness_lag_s) and mean wall time per generation
+    # (continual_gen_s) — the freshness guarantee as a perf metric
+    try:
+        import soak_serve
+        cr = soak_serve.run_continual_soak(
+            duration_s=2.0 if cpu else 4.0, clients=2, generations=2,
+            gate_failure=False)
+        _record_point(
+            "continual", cpu=cpu,
+            freshness_lag_s=cr.get("freshness_lag_s"),
+            gen_s=cr.get("gen_s"),
+            published=(cr.get("freshness") or {}).get(
+                "generations_published"),
+            violations=len(cr.get("violations") or []))
+    except Exception as e:
+        _record_point("continual", error=f"{type(e).__name__}: {e}"[:200])
+
     # comm wire bytes per boosting iteration (obs/comm.py static model,
     # same math the telemetry counters use at train time): the in-flight
     # number arXiv:1706.08359 instruments to validate scaling — one

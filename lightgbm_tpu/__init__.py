@@ -18,14 +18,17 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .dataset import Dataset, Sequence
 from .engine import CVBooster, cv, train
+from .pipeline import ContinualTrainer, GateFailure
 from .plotting import (create_tree_digraph, plot_importance, plot_metric,
                        plot_split_value_histogram, plot_tree)
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import register_logger
 
 __all__ = [
-    "BinMapper", "BinType", "MissingType", "Booster", "Config", "CVBooster",
-    "Dataset", "EarlyStopException", "LightGBMError", "Sequence", "cv",
+    "BinMapper", "BinType", "MissingType", "Booster", "Config",
+    "ContinualTrainer", "CVBooster",
+    "Dataset", "EarlyStopException", "GateFailure", "LightGBMError",
+    "Sequence", "cv",
     "early_stopping", "log_evaluation", "log_telemetry",
     "record_evaluation", "reset_parameter", "train",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",

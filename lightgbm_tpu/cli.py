@@ -22,8 +22,8 @@ from .engine import train as train_fn
 from . import callback as cb
 
 
-_BARE_TASKS = ("train", "predict", "refit", "serve", "save_binary",
-               "convert_model")
+_BARE_TASKS = ("train", "predict", "refit", "serve", "continual",
+               "save_binary", "convert_model")
 
 
 def _load_params(argv: List[str]) -> Dict[str, str]:
@@ -56,6 +56,8 @@ def run(argv: List[str]) -> int:
         return _task_refit(cfg, params)
     if task == "serve":
         return _task_serve(cfg, params)
+    if task == "continual":
+        return _task_continual(cfg, params)
     if task == "save_binary":
         return _task_save_binary(cfg, params)
     if task == "convert_model":
@@ -172,6 +174,44 @@ def _task_serve(cfg: Config, params: Dict) -> int:
         if "server" in locals():
             server.close()
     return 0
+
+
+def _task_continual(cfg: Config, params: Dict) -> int:
+    """``task=continual`` / ``python -m lightgbm_tpu continual``: the
+    freshness-guaranteed continual boosting loop
+    (docs/Continual-Training.md).  ``data`` is the base training file;
+    each file in ``continual_data`` is appended as one generation —
+    boost ``continual_rounds`` from the newest snapshot, publish a
+    SHA-pinned artifact under ``output_model``, promote it through the
+    two-stage gate (engine self-check + shadow parity probe), roll back
+    and quarantine on any gate failure.  A serving process pointed at
+    the same ``output_model`` (``task=serve resume=true``) hot-reloads
+    the published generations via ``POST /promote``.  Prints one JSON
+    report per generation; exit 0 when at least one generation
+    published."""
+    import json as _json
+
+    from .pipeline.continual import ContinualTrainer
+    t0 = time.time()
+    base_x, base_y = load_text(cfg.data, has_header=cfg.header,
+                               label_column=cfg.label_column)
+    trainer = ContinualTrainer(params, base_x, base_y)
+    # the base generation publishes the first incumbent (no parity gate
+    # yet — there is nothing to compare against)
+    reports = [trainer.run_generation()]
+    for chunk_path in (cfg.continual_data or []):
+        x, y = load_text(str(chunk_path), has_header=cfg.header,
+                         label_column=cfg.label_column)
+        reports.append(trainer.run_generation(x, y))
+    for r in reports:
+        print(_json.dumps(r, default=str))
+    ok = sum(r["status"] == "published" for r in reports)
+    rb = len(reports) - ok
+    print(f"continual: {ok}/{len(reports)} generations published"
+          f"{f', {rb} rolled back' if rb else ''} in "
+          f"{time.time() - t0:.2f} seconds; newest artifact under "
+          f"{cfg.output_model}.snapshot_iter_*")
+    return 0 if ok else 1
 
 
 def _task_refit(cfg: Config, params: Dict) -> int:

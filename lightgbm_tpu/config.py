@@ -289,6 +289,60 @@ _PARAMS: Dict[str, tuple] = {
     # through the init_model path (engine.py); never recorded in the
     # saved model's parameters section
     "resume": (bool, False, ["auto_resume"]),
+    # ---- continual training (lightgbm_tpu/pipeline/continual.py) ----
+    # boosting iterations per continual generation: each generation
+    # appends a data chunk and boosts this many more rounds from the
+    # newest complete snapshot via the init_model path
+    "continual_rounds": (int, 10, []),
+    # shrink the contribution of the trees carried over from previous
+    # generations by this factor each generation (Tree::Shrinkage over
+    # the loaded model before the init score is computed); 1.0 = no
+    # decay.  Refused for linear-tree models (only the constant leaf
+    # values would decay, like refit)
+    "continual_decay": (float, 1.0, []),
+    # retries per pipeline stage (append/boost/publish/promote) for
+    # transient failures, on top of the first attempt; gate refusals
+    # (GateFailure) are never retried — they roll back
+    "continual_retries": (int, 1, []),
+    # promotion-gate budget (seconds): a shadow-parity probe that has
+    # not finished within it is a gate FAILURE (automatic rollback), not
+    # a wait.  0 = no timeout
+    "continual_timeout_s": (float, 30.0, []),
+    # where gate-failed candidates are moved (model + sidecars + a
+    # blackbox reason dump) so the next generation can never boost from
+    # them; empty derives <output_model>.quarantine
+    "continual_quarantine_dir": (str, "", []),
+    # CLI task=continual chunk sources: files appended one generation
+    # each, after the base generation trained from ``data``
+    "continual_data": (list, None, ["continual_chunks"]),
+    # shadow-traffic parity probe: how many of the last live serve
+    # batches are replayed through a promotion candidate (the serve
+    # server keeps a ring of this many batches; without live traffic
+    # the probe replays slices of the newest data chunk).  0 disables
+    # the replay entirely — the metric-regression gate still applies
+    "shadow_probe_batches": (int, 8, []),
+    # objective-aware score-DRIFT bound of the probe: probability-like
+    # outputs (binary/multiclass/xentropy) compare absolutely, unbounded
+    # outputs relative to the incumbent's scale.  This is the
+    # freshness-vs-stability budget — how far a candidate may move live
+    # scores — not a corruption check (that is the lineage gate below);
+    # the permissive default only rejects insanity.  NOTE: probability
+    # drift is bounded by 1.0, so at the default the probability leg
+    # enforces only finiteness/shape — set an explicit tolerance to
+    # bound how far a candidate may move classification scores
+    "shadow_probe_tolerance": (float, 1.0, []),
+    # lineage-parity tolerance (relative): the candidate's raw-score
+    # prefix over the incumbent's iteration count must reproduce the
+    # (decayed) incumbent's raw scores to float rounding — the
+    # convergence-independent corruption catcher.  Applied only when the
+    # candidate is a continuation of the serving incumbent (the
+    # trainer's own promotions; POST /promote of an unrelated retrain
+    # skips it)
+    "shadow_probe_lineage_tolerance": (float, 1e-9, []),
+    # allowed eval-metric regression of the candidate vs the incumbent
+    # on the gate set (the newest chunk): worse by more than this and
+    # the promotion rolls back
+    "shadow_probe_metric_tolerance": (float, 0.0, []),
     # ---- serving (lightgbm_tpu/serve/, docs/Serving.md) ----
     # micro-batch cap in rows: the batcher dispatches a batch as soon as
     # this many rows are queued; also the engine's bucket cap, bounding
@@ -645,6 +699,23 @@ class Config:
         if self.serve_breaker_failures < 0:
             raise ValueError("serve_breaker_failures must be >= 0 "
                              "(0 disables the breaker)")
+        if self.continual_rounds < 1:
+            raise ValueError("continual_rounds must be >= 1")
+        if not (0.0 < self.continual_decay <= 1.0):
+            raise ValueError("continual_decay must be in (0, 1] "
+                             "(1 = no decay)")
+        if self.continual_retries < 0:
+            raise ValueError("continual_retries must be >= 0")
+        if self.continual_timeout_s < 0:
+            raise ValueError("continual_timeout_s must be >= 0 "
+                             "(0 = no gate timeout)")
+        if self.shadow_probe_batches < 0:
+            raise ValueError("shadow_probe_batches must be >= 0")
+        for knob in ("shadow_probe_tolerance",
+                     "shadow_probe_metric_tolerance",
+                     "shadow_probe_lineage_tolerance"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0")
         # verbosity drives the global log level with reference semantics
         # (config.h: <0 fatal-only, 0 warnings, 1 info, >=2 debug; the
         # reference's Config::Set calls Log::ResetLogLevel the same way)

@@ -95,12 +95,28 @@ def train(params: Dict[str, Any], train_set: Dataset,
         from .snapshot import find_latest_snapshot
         from .utils.log import Log
         found = find_latest_snapshot(cfg.output_model, snap_sig, train_set)
+        if found is not None:
+            resume_start, snap_path, snap_score = found
+            try:
+                prev_booster = Booster(model_file=snap_path)
+            except FileNotFoundError:
+                # the snapshot the finder located was pruned before the
+                # open (a concurrent writer's prune_snapshots —
+                # find->open TOCTOU): re-scan ONCE instead of failing
+                # the bring-up; an older valid snapshot still resumes
+                Log.warning(f"snapshot {snap_path} vanished between "
+                            "lookup and load; re-scanning once")
+                found = find_latest_snapshot(cfg.output_model, snap_sig,
+                                             train_set)
+                if found is not None:
+                    resume_start, snap_path, snap_score = found
+                    prev_booster = Booster(model_file=snap_path)
+                else:
+                    resume_start = 0
         if found is None:
             Log.info("resume=true but no valid snapshot found for "
                      f"{cfg.output_model!r}; training from scratch")
         else:
-            resume_start, snap_path, snap_score = found
-            prev_booster = Booster(model_file=snap_path)
             # the saved f32 training score IS the device state at the
             # snapshot — feeding it back through the init_model path
             # continues training bit-exactly where the crash hit (a
@@ -115,6 +131,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # align iteration-keyed RNG streams (bagging epochs, GOSS keys,
         # feature-fraction draws) with the straight run
         booster._model.set_resume_state(resume_start)
+    # early stopping reports best_iteration ABSOLUTE over the final
+    # merged forest: with an explicit init_model the loop index starts
+    # at 0 while the forest still carries the previous model's trees
+    # (predict/save slicing at a run-relative index would silently drop
+    # the continuation's best trees); a RESUMED run's loop index is
+    # already absolute (it starts at resume_start == the snapshot's
+    # iterations), so the two offsets cancel there
+    best_iter_offset = 0
+    if prev_booster is not None:
+        k = max(1, booster._num_tree_per_iteration)
+        best_iter_offset = len(prev_booster.trees) // k - resume_start
     train_eval_name = None
     if valid_sets:
         names = valid_names or [
@@ -219,7 +246,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for cb in cbs_after:
                 cb(env)
         except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
+            booster.best_iteration = best_iter_offset + e.best_iteration + 1
             for (name, metric, value, _) in e.best_score:
                 booster.best_score.setdefault(name, {})[metric] = value
             # roll back to best iteration for prediction default

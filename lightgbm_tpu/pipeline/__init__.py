@@ -1,0 +1,14 @@
+"""Training-to-serving pipelines.
+
+``continual`` — the freshness-guaranteed continual boosting loop
+(ROADMAP item 6): append data, boost from the newest snapshot, publish
+a SHA-pinned artifact, promote it into the serving registry through a
+two-stage gate (engine self-check + shadow-traffic parity probe), and
+roll back automatically on any failure.  docs/Continual-Training.md.
+"""
+
+from __future__ import annotations
+
+from .continual import ContinualTrainer, GateFailure, gated_promote
+
+__all__ = ["ContinualTrainer", "GateFailure", "gated_promote"]

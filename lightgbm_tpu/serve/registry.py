@@ -40,9 +40,24 @@ class ArtifactVerificationError(RuntimeError):
 
 
 class ServedModel:
-    """One immutable (version, booster, engine) serving unit."""
+    """One immutable (version, booster, engine) serving unit.
 
-    __slots__ = ("version", "booster", "engine", "source", "loaded_at")
+    Carries an IN-FLIGHT request counter (``begin_request`` /
+    ``end_request``, bracketed around every batch the server runs on
+    this version): the residency-cap eviction skips versions with
+    requests in flight.  This is residency ACCOUNTING, not a
+    use-after-free guard — the batch's own reference keeps the model
+    alive regardless; the counter keeps a mid-batch version registered
+    (addressable, its device tables resident) so a swap back to it
+    never pays a re-upload the cap bookkeeping thought it had
+    reclaimed.  ``self_check_failed`` records
+    that the engine's byte-parity probe FAILED at load (as opposed to
+    the engine being unsupported) — the continual promotion gate refuses
+    such candidates outright where plain serving merely demotes them to
+    the host walk."""
+
+    __slots__ = ("version", "booster", "engine", "source", "loaded_at",
+                 "self_check_failed", "sha256", "_inflight", "_iflock")
 
     def __init__(self, version: str, booster, engine, source: str):
         self.version = version
@@ -50,6 +65,26 @@ class ServedModel:
         self.engine = engine
         self.source = source
         self.loaded_at = time.time()
+        self.self_check_failed = False
+        # the verified artifact checksum this version was loaded under
+        # (None for live boosters / unpinned loads) — the continual
+        # gate uses it to decide whether the serving incumbent IS the
+        # snapshot a candidate boosted from (lineage applicability)
+        self.sha256: "str | None" = None
+        self._inflight = 0
+        self._iflock = threading.Lock()
+
+    def begin_request(self) -> None:
+        with self._iflock:
+            self._inflight += 1
+
+    def end_request(self) -> None:
+        with self._iflock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
 
     def describe(self) -> dict:
         return {"version": self.version, "source": self.source,
@@ -57,6 +92,7 @@ class ServedModel:
                 "num_trees": len(self.booster.trees),
                 "num_class": self.booster._num_tree_per_iteration,
                 "num_features": self.booster.num_feature(),
+                "inflight": self._inflight,
                 "fingerprint": self.engine.fingerprint
                 if self.engine is not None else None}
 
@@ -161,6 +197,7 @@ class ModelRegistry:
         else:
             source = source or "<booster>"
         engine = None
+        self_check_failed = False
         if self._build_engine:
             from .engine import EngineUnsupported, PredictorEngine
             try:
@@ -187,6 +224,7 @@ class ModelRegistry:
                             f"{source}; discarding engine, serving via "
                             "host walk")
                         engine = None
+                        self_check_failed = True
                         booster._engine_cache = False
             except EngineUnsupported as e:
                 # an engine-unsupported model is still SERVABLE — the
@@ -210,18 +248,31 @@ class ModelRegistry:
                 raise ValueError(f"model version {version!r} already "
                                  "registered")
             served = ServedModel(version, booster, engine, source)
+            served.self_check_failed = self_check_failed
+            served.sha256 = expected_sha256 or None
             self._models[version] = served
-            if activate or self._current is None:
+            if activate:
+                # an explicit shadow load (activate=False) NEVER takes
+                # traffic — not even into an empty registry: the gated
+                # promotion relies on a refused candidate having served
+                # zero requests, and an auto-activated shadow would
+                # serve during the gate window (model-less registries
+                # answer NoModelError until something activates)
                 self._current = served
             if self._max_resident > 0:
                 # evict oldest non-current versions past the residency
                 # cap — the bound on co-hosted HBM footprint.  The
                 # just-registered version is never an eviction
                 # candidate: a shadow load (activate=False) at the cap
-                # must displace an OLDER version, not itself
+                # must displace an OLDER version, not itself.  Versions
+                # with requests IN FLIGHT are skipped too — a batch that
+                # resolved its handle must finish on the tables it is
+                # traversing; such versions exceed the cap transiently
+                # and become evictable at the next load
                 others = sorted(
                     (m for m in self._models.values()
-                     if m is not self._current and m is not served),
+                     if m is not self._current and m is not served
+                     and m.inflight == 0),
                     key=lambda m: m.loaded_at)
                 while len(self._models) > self._max_resident and others:
                     self._models.pop(others.pop(0).version, None)
@@ -242,34 +293,64 @@ class ModelRegistry:
         refused, not activated."""
         import json
 
-        from ..snapshot import find_latest_complete_snapshot
-        found = find_latest_complete_snapshot(output_model,
-                                              verify=self._verify)
-        if found is None:
-            raise FileNotFoundError(
-                f"no complete snapshot of {output_model!r} found")
-        it, path = found
-        expected = expected_sha256
-        if expected is None and self._verify:
+        from ..snapshot import find_latest_complete_snapshot, pin_snapshot
+        from ..utils.log import Log
+        for attempt in (0, 1):
+            found = find_latest_complete_snapshot(output_model,
+                                                  verify=self._verify)
+            if found is None:
+                raise FileNotFoundError(
+                    f"no complete snapshot of {output_model!r} found")
+            it, path = found
             try:
-                # utf-8 like every artifact read (the manifest is
-                # ASCII-escaped JSON today, but the convention is one
-                # encoding on both sides of every checksummed file)
-                with open(path + ".manifest.json",
-                          encoding="utf-8") as f:
-                    expected = json.load(f).get("model_sha256")
-            except (OSError, ValueError) as e:
-                # the manifest the finder JUST parsed is gone or torn
-                # (pruned mid-load, bit rot): refuse — silently loading
-                # with expected=None would be exactly the unverified
-                # activation serve_verify_artifacts exists to prevent
-                raise ArtifactVerificationError(
-                    f"snapshot manifest {path}.manifest.json became "
-                    f"unreadable mid-load ({e}); refusing unverified "
-                    "activation") from e
-        return self.load(model_file=path, version=version,
-                         source=f"{path} (snapshot iter {it})",
-                         activate=activate, expected_sha256=expected)
+                # pinned for the whole find->read window: a concurrent
+                # writer's prune_snapshots (continual publish) holds
+                # this generation until the load finishes
+                with pin_snapshot(path):
+                    expected = expected_sha256
+                    if expected is None and self._verify:
+                        try:
+                            # utf-8 like every artifact read (the
+                            # manifest is ASCII-escaped JSON today, but
+                            # the convention is one encoding on both
+                            # sides of every checksummed file)
+                            with open(path + ".manifest.json",
+                                      encoding="utf-8") as f:
+                                expected = json.load(f).get(
+                                    "model_sha256")
+                        except FileNotFoundError:
+                            raise     # pruned mid-load: re-scan below
+                        except (OSError, ValueError) as e:
+                            # the manifest the finder JUST parsed is
+                            # torn (bit rot): refuse — silently loading
+                            # with expected=None would be exactly the
+                            # unverified activation
+                            # serve_verify_artifacts exists to prevent
+                            raise ArtifactVerificationError(
+                                f"snapshot manifest "
+                                f"{path}.manifest.json became "
+                                f"unreadable mid-load ({e}); refusing "
+                                "unverified activation") from e
+                    return self.load(
+                        model_file=path, version=version,
+                        source=f"{path} (snapshot iter {it})",
+                        activate=activate, expected_sha256=expected)
+            except FileNotFoundError:
+                # the generation the finder located was pruned before
+                # this reader could pin it (the unavoidable race: the
+                # pin lands after the find).  An older complete
+                # snapshot is still a valid bring-up — re-scan ONCE
+                # instead of failing; a second miss is a real error
+                if attempt:
+                    raise
+                Log.warning(f"snapshot {path} vanished between lookup "
+                            "and load (pruned by a concurrent writer); "
+                            "re-scanning once")
+
+    @property
+    def max_resident(self) -> int:
+        """The co-hosting residency cap (0 = unlimited)."""
+        return self._max_resident
 
     # -- swap / lookup -----------------------------------------------------
     def activate(self, version: str) -> None:
@@ -296,14 +377,22 @@ class ModelRegistry:
                 raise KeyError(f"unknown model version {version!r}") \
                     from None
 
-    def unload(self, version: str) -> None:
+    def unload(self, version: str, force: bool = False) -> None:
         """Drop a non-current version (the current one must be swapped
-        away first)."""
+        away first — unloading what is serving would strand the next
+        request with no model).  ``force=True`` expels even the current
+        version, returning the registry to model-less; it exists as the
+        gated-promotion rollback's belt-and-braces (shadow loads never
+        auto-activate, so in normal operation a refused candidate is
+        never current — force covers operator surgery and defensive
+        rollback paths only)."""
         with self._lock:
             if self._current is not None \
                     and self._current.version == version:
-                raise ValueError("cannot unload the current version; "
-                                 "activate another first")
+                if not force:
+                    raise ValueError("cannot unload the current "
+                                     "version; activate another first")
+                self._current = None
             self._models.pop(version, None)
 
     def versions(self) -> List[dict]:

@@ -32,6 +32,14 @@ that failed the self-check gate) demote to the host walk, counted in
   optional ``"sha256"`` to pin the artifact) -> hot swap, in-flight
   requests finish on the old version; 409 on checksum mismatch (the
   current version keeps serving).
+- ``POST /promote``  GATED promotion (pipeline/continual.py): the
+  candidate activates only after SHA verification + engine self-check
+  + a shadow-traffic parity probe over the last K live batches; 409
+  with the refusing stage + reason on failure (the incumbent keeps
+  serving, the candidate never took a request).
+- ``GET /freshness`` serving staleness: current version + age,
+  continual generations published / rolled back, and the
+  chunk-arrival-to-serving lag when a ContinualTrainer is attached.
 - ``POST /drain``    graceful shutdown prologue: refuse new work,
   finish queued work within ``serve_drain_s``; ``/healthz`` flips to
   503 so load balancers stop routing here.
@@ -124,6 +132,18 @@ class Server:
             metrics=self.metrics, tracer=self.tracer)
         self._t0 = time.time()
         self._closed = False
+        # shadow-traffic ring (pipeline/continual.py): the last K live
+        # batches, replayed through a promotion candidate by the
+        # shadow-parity gate.  Array REFERENCES only — no copy, no
+        # device work, bounded by shadow_probe_batches
+        from collections import deque
+        # maxlen=0 (shadow_probe_batches=0) keeps the ring permanently
+        # empty: the replay probe is disabled, not clamped to 1
+        self._shadow_ring = deque(
+            maxlen=max(0, cfg.shadow_probe_batches))
+        # attached ContinualTrainer (trainer constructor sets it):
+        # GET /freshness reads its generation/lag state when present
+        self.continual = None
         # flight recorder (obs/blackbox.py): per-batch records, dumped
         # on a batch failure; None (zero-cost) unless telemetry_blackbox
         from ..obs.blackbox import maybe_recorder
@@ -140,24 +160,31 @@ class Server:
             served = self.registry.current()   # resolved per batch:
             # requests already in this batch finish on it even if a
             # reload lands now
-            if self.config.serve_device_binning:
-                eng = served.engine
-                if eng is not None and eng.fused_reason is None:
-                    # device-resident fast path: ONE jitted
-                    # bin->traverse->accumulate->transform program, one
-                    # host<->device sync (the final score fetch)
-                    out = eng.fused_predict(rows)
-                    self.metrics.counter("serve.fused_batches").inc()
+            served.begin_request()             # residency-cap eviction
+            # skips versions with requests in flight (registry.py)
+            try:
+                if self.config.serve_device_binning:
+                    eng = served.engine
+                    if eng is not None and eng.fused_reason is None:
+                        # device-resident fast path: ONE jitted
+                        # bin->traverse->accumulate->transform program,
+                        # one host<->device sync (the final score fetch)
+                        out = eng.fused_predict(rows)
+                        self.metrics.counter("serve.fused_batches").inc()
+                    else:
+                        # demoted (failed self-check discarded the
+                        # engine) or fused-incapable (linear trees,
+                        # f32-inexact categories): the always-correct
+                        # host walk serves — slower, never wrong, never
+                        # refused
+                        self.metrics.counter(
+                            "serve.host_fallback_batches").inc()
+                        out = served.booster.predict(rows)
                 else:
-                    # demoted (failed self-check discarded the engine)
-                    # or fused-incapable (linear trees, f32-inexact
-                    # categories): the always-correct host walk serves
-                    # — slower, never wrong, never refused
-                    self.metrics.counter(
-                        "serve.host_fallback_batches").inc()
                     out = served.booster.predict(rows)
-            else:
-                out = served.booster.predict(rows)
+            finally:
+                served.end_request()
+            self._shadow_ring.append(rows)     # shadow-parity gate feed
         except Exception as e:
             if self.recorder is not None:
                 # the batch-failure path is a flight-recorder trigger:
@@ -226,6 +253,72 @@ class Server:
         self._versions_loaded += 1
         Log.info(f"serve: activated model {version}")
         return version
+
+    # -- continual surface -------------------------------------------------
+    def shadow_batches(self):
+        """The last K live request batches (shadow_probe_batches ring) —
+        the replay traffic of the shadow-parity promotion gate."""
+        return list(self._shadow_ring)
+
+    def promote(self, snapshot: Optional[str] = None,
+                model_file: Optional[str] = None,
+                expected_sha256: Optional[str] = None,
+                version: Optional[str] = None):
+        """GATED promotion (``POST /promote``): unlike :meth:`reload`,
+        the candidate activates only after the two-stage gate — SHA
+        verification + engine self-check, then the shadow-traffic
+        parity probe over the live-batch ring against the incumbent
+        (pipeline/continual.py ``gated_promote``).  A refusal raises
+        :class:`~..pipeline.continual.GateFailure`, counts
+        ``continual.rollbacks``, and leaves the incumbent serving —
+        the candidate never takes a request."""
+        from ..pipeline.continual import GateFailure, gated_promote
+        try:
+            v, gate = gated_promote(
+                self.registry, snapshot=snapshot, model_file=model_file,
+                expected_sha256=expected_sha256, cfg=self.config,
+                batches=self.shadow_batches(), metrics=self.metrics,
+                version=version)
+        except (GateFailure, ArtifactVerificationError):
+            # a REFUSED candidate is a rollback; a malformed operator
+            # call (bad args, missing file) is not
+            self.metrics.counter("continual.rollbacks").inc()
+            raise
+        self._versions_loaded += 1
+        self.metrics.counter("continual.published").inc()
+        Log.info(f"serve: gated promotion activated model {v}")
+        return v, gate
+
+    def freshness(self) -> dict:
+        """``GET /freshness``: how stale is what this replica serves —
+        current version + its age, continual generation counters, and
+        the chunk-arrival-to-serving lag when a ContinualTrainer is
+        attached (its headline freshness guarantee)."""
+        now = time.time()
+        try:
+            cur = self.registry.current()
+        except NoModelError:
+            cur = None
+        out = {
+            "model_version": cur.version if cur else None,
+            "model_source": cur.source if cur else None,
+            "model_loaded_at": cur.loaded_at if cur else None,
+            "model_age_s": round(now - cur.loaded_at, 3) if cur else None,
+            "generations_published":
+                self.metrics.counter("continual.published").value,
+            "generations_rolled_back":
+                self.metrics.counter("continual.rollbacks").value,
+        }
+        ct = self.continual
+        if ct is not None:
+            out["generation"] = ct.generation
+            out["freshness_lag_s"] = ct.freshness_lag_s(now)
+            out["last_publish"] = dict(ct.last_publish) or None
+        else:
+            # no trainer attached: the model's age IS the only lag
+            # signal this replica has
+            out["freshness_lag_s"] = out["model_age_s"]
+        return out
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -415,6 +508,8 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 # health() computes "ready" — route on it so code and
                 # body can never disagree
                 self._send(200 if h["ready"] else 503, h)
+            elif u.path == "/freshness":
+                self._send(200, server.freshness())
             elif u.path == "/metrics":
                 snap = server.metrics_snapshot()
                 if parse_qs(u.query).get("format", [""])[0] == "prom":
@@ -440,10 +535,18 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 self._predict(req)
             elif self.path == "/reload":
                 self._reload(req)
+            elif self.path == "/promote":
+                self._promote(req)
             elif self.path == "/drain":
                 self._send(200, server.drain(req.get("timeout_s")))
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
+
+        def _current_version(self):
+            try:
+                return server.registry.current().version
+            except NoModelError:
+                return None
 
         def _predict(self, req: dict) -> None:
             rows = req.get("rows")
@@ -526,14 +629,50 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
             except ArtifactVerificationError as e:
                 # the artifact is not what the caller said it was —
                 # conflict, not client-syntax error; current version
-                # keeps serving
-                self._send(409, {"error": str(e)})
+                # keeps serving.  The BODY carries the verification
+                # failure reason (which file, which checksums) plus the
+                # version still serving — a deploy script retrying on a
+                # bare 409 has nothing to page the operator with
+                self._send(409, {"error": str(e),
+                                 "reason": str(e),
+                                 "verification": "failed",
+                                 "current_version":
+                                     self._current_version()})
                 return
             except Exception as e:          # noqa: BLE001 — operator call
                 self._send(400,
                            {"error": f"{type(e).__name__}: {e}"})
                 return
             self._send(200, {"model_version": version})
+
+        def _promote(self, req: dict) -> None:
+            """Gated promotion: 200 with the gate report on pass; 409
+            with the stage + reason on any gate refusal (verification,
+            self-check, shadow parity) — the incumbent keeps serving
+            and the candidate never took a request."""
+            from ..pipeline.continual import GateFailure
+            try:
+                version, gate = server.promote(
+                    snapshot=req.get("snapshot"),
+                    model_file=req.get("model_file"),
+                    expected_sha256=req.get("sha256"))
+            except ArtifactVerificationError as e:
+                self._send(409, {"error": str(e), "reason": str(e),
+                                 "stage": "verify",
+                                 "current_version":
+                                     self._current_version()})
+                return
+            except GateFailure as e:
+                self._send(409, {"error": str(e), "reason": e.reason,
+                                 "stage": e.stage,
+                                 "current_version":
+                                     self._current_version()})
+                return
+            except Exception as e:          # noqa: BLE001 — operator call
+                self._send(400,
+                           {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._send(200, {"model_version": version, "gate": gate})
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True

@@ -34,13 +34,15 @@ produce byte-identical model text (tests/test_fault_tolerance.py).
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import hashlib
 import io
 import json
 import os
 import re
-from typing import Any, Dict, Optional, Tuple
+import threading
+from typing import Any, Dict, Optional, Set, Tuple
 
 import numpy as np
 
@@ -117,6 +119,42 @@ def verify_snapshot_artifacts(path: str, man: Dict[str, Any],
     return None
 
 
+# -- reader pins: close the find->open TOCTOU window -----------------------
+# A reader (serving hot-load, training resume) locates a snapshot with a
+# finder and only then opens its files; a concurrent writer's
+# prune_snapshots could delete that very generation in between (a
+# continual pipeline publishes + prunes while a registry loads).  Readers
+# pin the path for the duration; prune holds newest-N PLUS every pinned
+# generation.
+_pin_lock = threading.Lock()
+_pinned: Dict[str, int] = {}
+
+
+@contextlib.contextmanager
+def pin_snapshot(path: str):
+    """Hold ``path`` (a snapshot model file) against
+    :func:`prune_snapshots` while a reader is between locating it and
+    finishing reading its files.  Re-entrant across threads (counted)."""
+    key = os.path.abspath(path)
+    with _pin_lock:
+        _pinned[key] = _pinned.get(key, 0) + 1
+    try:
+        yield path
+    finally:
+        with _pin_lock:
+            n = _pinned.get(key, 0) - 1
+            if n <= 0:
+                _pinned.pop(key, None)
+            else:
+                _pinned[key] = n
+
+
+def pinned_snapshots() -> Set[str]:
+    """Absolute paths currently pinned by active readers."""
+    with _pin_lock:
+        return set(_pinned)
+
+
 def _snapshot_path(output_model: str, iteration: int) -> str:
     return f"{output_model}.snapshot_iter_{iteration}"
 
@@ -183,10 +221,17 @@ def write_snapshot(booster, prev_booster, cfg, iteration: int,
 
 def prune_snapshots(output_model: str, keep: int) -> None:
     """Delete all but the ``keep`` newest snapshots (model + sidecars);
-    ``keep <= 0`` keeps everything."""
+    ``keep <= 0`` keeps everything.  Generations pinned by an active
+    reader (:func:`pin_snapshot` — a registry hot-load or resume that
+    located the snapshot but has not finished reading it) are held
+    regardless of age; they become prunable again at the next prune
+    after the reader unpins."""
     if keep <= 0:
         return
+    pinned = pinned_snapshots()
     for _it, path in _list_snapshots(output_model)[keep:]:
+        if os.path.abspath(path) in pinned:
+            continue
         for p in (path + ".manifest.json", path + ".state.npz", path):
             try:
                 os.unlink(p)

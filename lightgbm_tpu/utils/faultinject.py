@@ -43,6 +43,18 @@ Sites wired into the codebase:
 ``serve_reload``    model load/hot-swap entry (``serve/registry
                     .ModelRegistry.load``) — a failed reload must leave
                     the current version serving
+``continual_*``     the continual-boosting pipeline's stage boundaries
+                    (``pipeline/continual.py``): ``continual_append``
+                    (data-chunk ingest), ``continual_boost`` (boost k
+                    rounds from the newest snapshot),
+                    ``continual_publish`` (SHA-pinned artifact write),
+                    ``continual_promote`` (gated registry promotion) —
+                    each stage retries transients and rolls back to the
+                    incumbent on exhaustion
+``shadow_probe``    inside the shadow-traffic parity probe
+                    (``pipeline/continual.py shadow_parity_probe``) —
+                    a firing probe is a GATE FAILURE: the candidate is
+                    quarantined, the incumbent keeps serving
 ==================  ========================================================
 
 Also exercisable from ``tools/tpu_watch.py`` probes: export
@@ -59,7 +71,9 @@ ENV_VAR = "LGBM_TPU_FAULTS"
 
 KNOWN_SITES = ("device_claim", "collective", "snapshot_write",
                "snapshot_kill", "nan_grads", "serve_batch",
-               "serve_reload", "serve_self_check")
+               "serve_reload", "serve_self_check", "continual_append",
+               "continual_boost", "continual_publish",
+               "continual_promote", "shadow_probe")
 
 
 class InjectedFault(RuntimeError):

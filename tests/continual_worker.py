@@ -1,0 +1,84 @@
+"""Subprocess worker for the continual-pipeline kill matrix
+(tests/test_zcontinual.py): runs N generations of the continual loop
+over DETERMINISTIC data and writes the final incumbent's model text.
+
+The driver arms ``LGBM_TPU_FAULTS=<site>:<hit>:exit`` (a real
+``os._exit`` — the kill -9 analog) before one invocation, then re-runs
+without faults: the restart must SKIP generations whose snapshot
+already published (the newest complete snapshot's iteration tells it
+how far the dead run got) and converge to a final model BYTE-IDENTICAL
+with an uninterrupted run — the publish-is-the-unit-of-redo discipline.
+
+Usage: python continual_worker.py <outdir> <n_chunks>
+Writes <outdir>/final.txt (the newest snapshot's model text) and prints
+``WORKER_DONE`` on success.
+"""
+
+import os
+import sys
+
+
+def chunks_for(seed, n_feat, base_rows, chunk_rows, n_chunks):
+    """Deterministic base + chunk series shared by every invocation."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+
+    def one(n):
+        x = rs.randn(n, n_feat)
+        return x, x[:, 0] + 0.5 * x[:, 1] + 0.05 * rs.randn(n)
+
+    base = one(base_rows)
+    return base, [one(chunk_rows) for _ in range(n_chunks)]
+
+
+def main():
+    outdir = sys.argv[1]
+    n_chunks = int(sys.argv[2])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.pipeline.continual import ContinualTrainer
+    from lightgbm_tpu.snapshot import find_latest_complete_snapshot
+
+    out_model = os.path.join(outdir, "m.txt")
+    params = {"objective": "regression", "num_leaves": 6, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "output_model": out_model, "continual_rounds": 2,
+              "snapshot_keep": 0}      # keep all: the driver audits them
+    rounds = params["continual_rounds"]
+    (bx, by), chunks = chunks_for(7, 5, 160, 60, n_chunks)
+
+    trainer = ContinualTrainer(params, bx, by)
+    # restart awareness: a generation whose snapshot already published
+    # (iteration >= its target) is DONE — the data must still be
+    # appended so later generations train on the same rows, but no
+    # boosting is redone (byte-identical convergence depends on it)
+    found = find_latest_complete_snapshot(out_model)
+    done_iter = found[0] if found else 0
+    gen_reports = []
+    for g in range(n_chunks + 1):
+        target = rounds * (g + 1)
+        if g > 0:
+            x, y = chunks[g - 1]
+        if done_iter >= target:
+            if g > 0:
+                trainer.append_chunk(x, y)
+            continue
+        rep = trainer.run_generation(*((x, y) if g > 0 else ()))
+        gen_reports.append(rep)
+        if rep["status"] != "published":
+            print(f"WORKER_GEN_FAILED {rep}", flush=True)
+            sys.exit(3)
+    found = find_latest_complete_snapshot(out_model)
+    assert found is not None, "no complete snapshot after the run"
+    with open(found[1], encoding="utf-8") as f:
+        text = f.read()
+    with open(os.path.join(outdir, "final.txt"), "w",
+              encoding="utf-8") as f:
+        f.write(text)
+    print(f"WORKER_DONE iter={found[0]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,15 +51,16 @@ from typing import Dict, List, Optional, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUDGET = os.path.join(REPO, "tools", "perf_budget.txt")
 
-# direction-by-name defaults for --update: latency/compile metrics
-# gate downward, everything else (rates, MFU) upward
-_LOWER_BETTER = re.compile(r"(_ms|compile_s|_seconds)$")
+# direction-by-name defaults for --update: latency/compile/freshness
+# metrics gate downward, everything else (rates, MFU) upward
+_LOWER_BETTER = re.compile(r"(_ms|compile_s|_seconds|_lag_s|_gen_s)$")
 # extras worth gating by default: primary value, throughput points,
 # serve latency/throughput (host-accumulation AND fused device paths),
-# mfu
+# mfu, and the continual pipeline's freshness numbers
 _GATEABLE = re.compile(
     r"(^value$|_iters_per_sec$|^serve(_device)?_rows_per_s$"
-    r"|^serve(_device)?_p\d+_ms$|_mfu$|_compile_s$)")
+    r"|^serve(_device)?_p\d+_ms$|_mfu$|_compile_s$"
+    r"|^continual_(freshness_lag_s|gen_s)$)")
 _DEFAULT_TOL = {"higher": 0.20, "lower": 0.30}
 
 

@@ -335,7 +335,207 @@ def run_soak(duration_s: float = 2.0, clients: int = 4,
     return report
 
 
+def run_continual_soak(duration_s: float = 4.0, clients: int = 3,
+                       generations: int = 2, seed: int = 0,
+                       gate_failure: bool = True, rows: int = 240,
+                       chunk_rows: int = 120,
+                       params: Optional[Dict] = None) -> Dict:
+    """Continual-pipeline chaos soak (docs/Continual-Training.md): a
+    live ``Server`` takes traffic from concurrent clients while a
+    ``ContinualTrainer`` runs ``generations`` generations against its
+    registry.  With ``gate_failure`` the FIRST continual generation's
+    shadow probe is made to fail (injected ``shadow_probe`` fault) and
+    must roll back.  Invariants checked:
+
+    - the incumbent serves THROUGHOUT — every response carries a
+      version that passed the gate; a rolled-back candidate's version
+      never serves a single request;
+    - no accepted request is lost or hung;
+    - rollback is automatic and counted (``continual.rollbacks``), and
+      the pipeline RECOVERS: the following generation publishes and its
+      version takes traffic;
+    - freshness is observable (``/freshness``-backed trainer state).
+    """
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu  # noqa: F401 — path bootstrap before pipeline
+    from lightgbm_tpu.pipeline.continual import ContinualTrainer
+    from lightgbm_tpu.serve import (BacklogFull, BatcherClosed,
+                                    BatcherDraining, CircuitOpen,
+                                    DeadlineExceeded, Server)
+    from lightgbm_tpu.utils import faultinject
+
+    rs = np.random.RandomState(seed)
+
+    def chunk(n):
+        x = rs.randn(n, N_FEAT)
+        return x, x[:, 0] + 0.5 * x[:, 1] + 0.05 * rs.randn(n)
+
+    tmpdir = tempfile.mkdtemp(prefix="lgbtpu_continual_soak_")
+    try:
+        srv_params = {"objective": "regression", "num_leaves": 8,
+                      "min_data_in_leaf": 5, "verbosity": -1,
+                      "output_model": os.path.join(tmpdir, "m.txt"),
+                      "continual_rounds": 3, "serve_max_batch": 64,
+                      "serve_max_wait_ms": 1.0, "serve_queue_rows": 256}
+        srv_params.update(params or {})
+        srv = Server(srv_params)
+        x0, y0 = chunk(rows)
+        trainer = ContinualTrainer(srv_params, x0, y0, server=srv)
+        base = trainer.run_generation()           # first incumbent
+        violations: list = []
+        vlock = threading.Lock()
+
+        def violate(msg: str) -> None:
+            with vlock:
+                violations.append(msg)
+
+        if base["status"] != "published":
+            violate(f"base generation failed: {base}")
+        promoted = {base.get("version")}
+        refused: set = set()
+        served_versions: set = set()
+        stop = threading.Event()
+        counts = collections.Counter()
+        clock = threading.Lock()
+
+        def client(tid):
+            crs = np.random.RandomState(seed * 100 + tid)
+            while not stop.is_set():
+                rows_ = crs.randn(int(crs.randint(1, 24)), N_FEAT)
+                try:
+                    fut = srv.submit(rows_)
+                except (BacklogFull, CircuitOpen, DeadlineExceeded,
+                        BatcherDraining):
+                    stop.wait(0.002)
+                    continue
+                try:
+                    out = fut.result(timeout=15.0)
+                except TimeoutError:
+                    violate("request hung past 15s")
+                    with clock:
+                        counts["hung"] += 1
+                    continue
+                except Exception:   # noqa: BLE001 — incl. BatcherClosed
+                    with clock:
+                        counts["error"] += 1
+                    continue
+                with clock:
+                    counts["ok"] += 1
+                    served_versions.add(fut.info.get("model_version"))
+                if not np.all(np.isfinite(np.asarray(out))):
+                    violate("non-finite prediction served")
+
+        threads = [threading.Thread(target=client, args=(t,), daemon=True,
+                                    name=f"continual-soak-client-{t}")
+                   for t in range(clients)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        reports = [base]
+        deadline = t0 + duration_s
+        for g in range(generations):
+            if gate_failure and g == 0:
+                # one injected gate failure: the probe fires, the candidate
+                # must quarantine and the incumbent keep serving
+                faultinject.configure("shadow_probe:1-")
+            rep = trainer.run_generation(*chunk(chunk_rows))
+            faultinject.configure(None)
+            reports.append(rep)
+            if rep["status"] == "published":
+                promoted.add(rep["version"])
+            elif rep.get("version_refused"):
+                refused.add(rep["version_refused"])
+            if gate_failure and g == 0 and rep["status"] != "rolled_back":
+                violate(f"injected gate failure did not roll back: {rep}")
+            if (not gate_failure or g > 0) and rep["status"] != "published":
+                violate(f"clean generation {g} failed: {rep}")
+        # keep traffic flowing a moment on the final model
+        while time.perf_counter() < deadline and not stop.is_set():
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+            if t.is_alive():
+                violate(f"thread {t.name} failed to stop")
+        faultinject.configure(None)
+        # gate invariants, judged on the COMPLETE ledger (checking inside
+        # the client threads would race the activation): every version that
+        # served passed the gate; a refused candidate never served
+        ghost = served_versions - promoted
+        if ghost:
+            violate(f"responses from versions that never passed the gate: "
+                    f"{sorted(v for v in ghost if v)}")
+        hit = served_versions & refused
+        if hit:
+            violate(f"REFUSED candidate versions served requests: "
+                    f"{sorted(hit)}")
+        # the freshest published generation must be what serves now
+        cur = srv.registry.current().version
+        last_pub = [r for r in reports if r["status"] == "published"][-1]
+        if cur != last_pub["version"]:
+            violate(f"serving {cur!r}, expected freshest published "
+                    f"{last_pub['version']!r}")
+        fresh = srv.freshness()
+        snap = srv.metrics_snapshot()
+        drain = srv.drain(10.0)
+        if not drain["drained"]:
+            violate("drain timed out after continual soak")
+        gen_hist = snap.get("continual.generation_seconds") or {}
+        report = {
+            "duration_s": round(time.perf_counter() - t0, 3),
+            "mode": "continual",
+            # headline bench numbers (bench.py continual point ->
+            # perf_budget.txt pins): chunk-arrival-to-serving lag of the
+            # freshest generation, and mean wall time per generation
+            "freshness_lag_s": fresh.get("freshness_lag_s"),
+            "gen_s": round(gen_hist["sum"] / gen_hist["count"], 4)
+            if gen_hist.get("count") else None,
+            "generations": [
+                {k: r.get(k) for k in ("generation", "status", "version",
+                                       "iteration", "reason")}
+                for r in reports],
+            "counts": dict(sorted(counts.items())),
+            "current_version": cur,
+            "freshness": {k: fresh.get(k) for k in
+                          ("model_version", "generation", "freshness_lag_s",
+                           "generations_published",
+                           "generations_rolled_back")},
+            "metrics": {k: snap[k] for k in
+                        ("continual.generations", "continual.published",
+                         "continual.rollbacks", "continual.quarantined",
+                         "serve.requests", "serve.errors") if k in snap},
+            "violations": violations,
+        }
+        srv.close()
+        return report
+    finally:
+        # the soak's working dir (snapshots, sidecars,
+        # quarantine) is disposable: every bench/test
+        # invocation must not leave debris in /tmp
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main(argv) -> int:
+    if "--continual" in argv or \
+            dict(a.split("=", 1) for a in argv if "=" in a) \
+            .get("continual", "0") not in ("0", "false"):
+        kv = dict(a.split("=", 1) for a in argv if "=" in a)
+        report = run_continual_soak(
+            duration_s=float(kv.get("duration_s", 4.0)),
+            clients=int(kv.get("clients", 3)),
+            generations=int(kv.get("generations", 2)),
+            seed=int(kv.get("seed", 0)),
+            gate_failure=kv.get("gate_failure", "1") not in ("0", "false"))
+        print(json.dumps(report, indent=1, default=str))
+        if report["violations"]:
+            print(f"CONTINUAL SOAK FAILED: {len(report['violations'])} "
+                  "violation(s)", file=sys.stderr)
+            return 1
+        print("continual soak clean: no invariant violations",
+              file=sys.stderr)
+        return 0
     kv = dict(a.split("=", 1) for a in argv if "=" in a)
     report = run_soak(
         duration_s=float(kv.get("duration_s", 3.0)),
