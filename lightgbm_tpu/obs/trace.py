@@ -159,7 +159,9 @@ class Tracer:
 
     def durations(self, name: str) -> List[float]:
         """All recorded durations (seconds) of spans named ``name``."""
-        return [e["dur"] / 1e6 for e in self.events
+        with self._lock:                  # _emit appends concurrently
+            events = list(self.events)
+        return [e["dur"] / 1e6 for e in events
                 if e["name"] == name and e["ph"] == "X"]
 
     def flush(self) -> None:

@@ -375,7 +375,21 @@ class ContinualTrainer:
     ``status`` ``"published"`` or ``"rolled_back"``.  Attach a live
     ``serve.Server`` to promote into its registry (sharing its metrics
     registry and shadow-traffic ring) or run standalone — the gates run
-    either way, against an in-memory incumbent."""
+    either way, against an in-memory incumbent.
+
+    Thread topology: the generation loop runs on ONE trainer thread
+    (stages never overlap), but a live server's HTTP threads read the
+    freshness surface (``generation`` / :meth:`freshness_lag_s` /
+    ``last_publish`` via ``GET /freshness``) while a generation is in
+    flight — that cross-thread state is lock-guarded; the bulk data
+    (``_x``/``_chunk_x`` …) is trainer-thread-only and stays lock-free.
+
+    Lock contract (tools/analyze/check_races.py):
+        _lock guards: generation, _chunk_t, _last_promote_t
+        _lock guards: last_publish
+        registry type: lightgbm_tpu/serve/registry.py:ModelRegistry
+        server type: lightgbm_tpu/serve/server.py:Server
+    """
 
     def __init__(self, params, x=None, y=None, *, server=None,
                  registry=None):
@@ -413,6 +427,9 @@ class ContinualTrainer:
         self._retry = RetryPolicy(
             max_attempts=max(1, self.config.continual_retries + 1),
             base_delay_s=0.05, max_delay_s=1.0)
+        # guards the freshness surface served to HTTP threads (class
+        # docstring lock contract)
+        self._lock = threading.Lock()
         self.generation = 0             # completed (promoted) generations
         self.last_publish: Dict[str, Any] = {}
         self._incumbent = None          # standalone-mode gate anchor
@@ -456,13 +473,32 @@ class ContinualTrainer:
         """Seconds between the newest chunk's arrival and its model
         serving — the headline freshness number while a generation is
         in flight, frozen at the promoted lag after it lands."""
-        if self._chunk_t is None:
+        with self._lock:         # HTTP threads vs the trainer loop
+            chunk_t = self._chunk_t
+            promote_t = self._last_promote_t
+        return self._lag(chunk_t, promote_t, now)
+
+    @staticmethod
+    def _lag(chunk_t, promote_t, now=None) -> Optional[float]:
+        if chunk_t is None:
             return None
         now = time.time() if now is None else now
-        if self._last_promote_t is not None \
-                and self._last_promote_t >= self._chunk_t:
-            return round(self._last_promote_t - self._chunk_t, 6)
-        return round(now - self._chunk_t, 6)
+        if promote_t is not None and promote_t >= chunk_t:
+            return round(promote_t - chunk_t, 6)
+        return round(now - chunk_t, 6)
+
+    def freshness_snapshot(self, now: Optional[float] = None) -> Dict:
+        """One-lock snapshot of the freshness surface — the form
+        ``GET /freshness`` consumes.  Composing the same fields from
+        separate ``generation`` / :meth:`freshness_lag_s` /
+        ``last_publish`` reads would let a promote land between them
+        and serve a torn pair (generation N next to gen-N+1's publish
+        record)."""
+        with self._lock:
+            return {"generation": self.generation,
+                    "freshness_lag_s": self._lag(
+                        self._chunk_t, self._last_promote_t, now),
+                    "last_publish": dict(self.last_publish) or None}
 
     # -- stages ------------------------------------------------------------
     def append_chunk(self, x, y) -> None:
@@ -478,7 +514,8 @@ class ContinualTrainer:
                 self._x = np.concatenate([self._x, x], axis=0)
                 self._y = np.concatenate([self._y, y], axis=0)
             self._chunk_x, self._chunk_y = x, y
-            self._chunk_t = time.time()
+            with self._lock:     # /freshness reads the arrival stamp
+                self._chunk_t = time.time()
 
         self._stage("append", _do)
 
@@ -609,6 +646,8 @@ class ContinualTrainer:
         """The registry-less gate: same two stages, in-memory incumbent."""
         faultinject.check("continual_promote")
         t0 = time.perf_counter()
+        with self._lock:
+            gen_next = self.generation + 1
         from ..booster import Booster
         from ..snapshot import file_sha256
         got = file_sha256(path)
@@ -641,7 +680,7 @@ class ContinualTrainer:
                 raise GateFailure("shadow_probe", probe["reason"])
         self._incumbent = cand
         self._incumbent_sha = sha
-        version = f"gen{self.generation + 1}"
+        version = f"gen{gen_next}"
         report["version"] = version
         report["gate_s"] = round(time.perf_counter() - t0, 6)
         self.metrics.histogram("continual.gate_seconds").observe(
@@ -728,8 +767,10 @@ class ContinualTrainer:
                                 f"quarantined {src} ({e})")
                     continue
             moved.append(base + suffix)
+        with self._lock:
+            gen_next = self.generation + 1
         dump = {"reason": reason, "stage": stage, "model_sha256": sha,
-                "generation": self.generation + 1,
+                "generation": gen_next,
                 "quarantined_at": time.time(), "files": moved}
         try:
             atomic_write(os.path.join(qdir, base + ".blackbox.json"),
@@ -753,7 +794,9 @@ class ContinualTrainer:
         KeyboardInterrupt / SystemExit) propagate, the on-disk publish
         discipline makes the RESTART converge instead."""
         t_start = time.time()
-        report: Dict[str, Any] = {"generation": self.generation + 1,
+        with self._lock:
+            gen_next = self.generation + 1
+        report: Dict[str, Any] = {"generation": gen_next,
                                   "status": "published"}
         published: Optional[Tuple[str, str]] = None
         stage = "append"
@@ -767,17 +810,22 @@ class ContinualTrainer:
             published = (path, sha)
             stage = "promote"
             version, gate = self.promote(path, sha)
-            self.generation += 1
-            self._last_promote_t = time.time()
-            lag = self._last_promote_t - (self._chunk_t or t_start)
+            with self._lock:
+                # one atomic publish of the freshness surface: an HTTP
+                # reader never sees the new generation number with the
+                # old promote stamp (a transiently negative/huge lag)
+                self.generation += 1
+                gen_done = self.generation
+                promote_t = self._last_promote_t = time.time()
+                lag = promote_t - (self._chunk_t or t_start)
+                self.last_publish = {"version": version, "path": path,
+                                     "sha256": sha, "iteration": it,
+                                     "at": promote_t}
             self.metrics.counter("continual.published").inc()
             self.metrics.gauge("continual.freshness_lag_s").set(lag)
-            self.last_publish = {"version": version, "path": path,
-                                 "sha256": sha, "iteration": it,
-                                 "at": self._last_promote_t}
             report.update(version=version, sha256=sha, iteration=it,
                           gate=gate, freshness_lag_s=round(lag, 6))
-            Log.info(f"continual: generation {self.generation} "
+            Log.info(f"continual: generation {gen_done} "
                      f"published as {version} (iter {it}, freshness "
                      f"lag {lag:.3f}s)")
         except Exception as e:          # noqa: BLE001 — ANY in-process

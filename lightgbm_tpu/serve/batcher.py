@@ -136,6 +136,17 @@ class MicroBatcher:
     leading axis matches ``rows`` (sliced back per request), ``info`` a
     small dict attached to every future of the batch (model version
     etc.); a plain-array return is also accepted.
+
+    Lock contract (tools/analyze/check_races.py):
+        _lock guards: _queue, _depth_rows, _closed, _draining
+        _lock guards: _inflight, _ewma_batch_s
+        breaker type: lightgbm_tpu/serve/breaker.py:ServeBreaker
+
+    ``_wake`` is a Condition over ``_lock`` (one mutex).  The breaker
+    is called both under ``_lock`` (admission, shed) and outside it
+    (batch outcomes) — legal because the breaker's own lock is
+    leaf-level and never calls back into the batcher.
+    ``batches_dispatched`` is written by the worker thread only.
     """
 
     # how far before the earliest queued deadline the coalescing window
@@ -167,8 +178,8 @@ class MicroBatcher:
         self._inflight = False
         self.batches_dispatched = 0
         # EWMA of observed per-batch service time (seconds), written by
-        # the worker after each batch (GIL-atomic float store, read
-        # under the lock in submit); 0 until the first batch completes
+        # the worker after each batch and read by submit — both under
+        # _lock; 0 until the first batch completes
         self._ewma_batch_s = 0.0
         self._worker = threading.Thread(target=self._run,
                                         name="lgbtpu-serve-batcher",
@@ -406,9 +417,10 @@ class MicroBatcher:
         # failed batches count too: their (retry-inflated) duration is
         # exactly what the next queued request will wait through
         dur = time.perf_counter() - t0
-        prev = self._ewma_batch_s
-        self._ewma_batch_s = dur if prev == 0.0 \
-            else 0.25 * dur + 0.75 * prev
+        with self._lock:        # submit reads the EWMA under the lock
+            prev = self._ewma_batch_s
+            self._ewma_batch_s = dur if prev == 0.0 \
+                else 0.25 * dur + 0.75 * prev
 
     def _dispatch(self, batch: List[_Item]) -> None:
         n = sum(len(i.rows) for i in batch)

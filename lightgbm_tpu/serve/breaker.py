@@ -50,7 +50,15 @@ class CircuitOpen(RuntimeError):
 
 
 class ServeBreaker:
-    """The batcher-facing adapter around ``resilience.CircuitBreaker``."""
+    """The batcher-facing adapter around ``resilience.CircuitBreaker``.
+
+    Lock contract (tools/analyze/check_races.py):
+        _cb type: lightgbm_tpu/utils/resilience.py:CircuitBreaker
+
+    Holds no lock of its own: every method is a pass-through to the
+    breaker's internally-locked state machine (leaf-level — it never
+    calls back into the batcher), plus ``_last_opens``, which only the
+    worker thread's ``on_failure`` touches."""
 
     def __init__(self, failures: int = 5, cooldown_ms: float = 1000.0,
                  cooldown_max_ms: Optional[float] = None, metrics=None,

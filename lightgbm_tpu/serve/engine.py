@@ -232,9 +232,16 @@ def _transform_for(objective):
 class PredictorEngine:
     """One trained ensemble, flattened for batched device traversal.
 
-    Thread-safe: ``leaf_ids``/``raw_scores``/``predict`` may be called
-    concurrently (the jit cache and host accumulation are functional;
-    the bucket ledger is lock-guarded).
+    Thread-safe: ``leaf_ids``/``raw_scores``/``predict``/
+    ``fused_predict`` may be called concurrently (the jit cache and
+    host accumulation are functional; the bucket ledger and the lazy
+    device-table uploads are lock-guarded).
+
+    Lock contract (tools/analyze/check_races.py):
+        _lock guards: _buckets_seen, _fused_buckets
+        _lock guards: _bin_dev, _fused_dev
+
+    All other attributes are frozen at construction.
     """
 
     def __init__(self, trees, tree_weights, num_class: int,
@@ -530,26 +537,39 @@ class PredictorEngine:
 
     def _device_bin_tables(self):
         import jax.numpy as jnp
-        if self._bin_dev is None:
-            if self._device_bin_err:
-                raise EngineUnsupported(self._device_bin_err)
-            F = self.num_features
-            B, C = self._bin_table_widths()
-            thr = np.full((F, B), np.inf, np.float32)
-            zero_bin = np.zeros(F, np.int32)
-            cat_vals = np.full((F, C), np.inf, np.float32)
-            cat_len = np.zeros(F, np.int32)
-            for f, tab in enumerate(self.tables):
-                if tab.kind == "num":
-                    thr[f, :len(tab.thresholds)] = tab.thresholds
-                    zero_bin[f] = np.searchsorted(tab.thresholds, 0.0,
-                                                  "left")
-                elif tab.kind == "cat" and len(tab.cats):
-                    cat_vals[f, :len(tab.cats)] = tab.cats
-                    cat_len[f] = len(tab.cats)
-            self._bin_dev = (jnp.asarray(thr), jnp.asarray(zero_bin),
-                             jnp.asarray(cat_vals), jnp.asarray(cat_len))
-        return self._bin_dev
+        if self._device_bin_err:
+            raise EngineUnsupported(self._device_bin_err)
+        dev = self._bin_dev
+        if dev is not None:
+            # lock-free fast path (tools/race_allowlist.txt): the tuple
+            # is published whole under the lock below, so a non-None
+            # read is a complete table set — taking the lock here would
+            # serialize every serve chunk on a read-only access
+            return dev
+        # build-once under the lock: two first-batch threads must not
+        # upload the tables twice (wasted HBM + a fused/self-check
+        # batch briefly reading tables the other thread re-binds)
+        with self._lock:
+            if self._bin_dev is None:
+                F = self.num_features
+                B, C = self._bin_table_widths()
+                thr = np.full((F, B), np.inf, np.float32)
+                zero_bin = np.zeros(F, np.int32)
+                cat_vals = np.full((F, C), np.inf, np.float32)
+                cat_len = np.zeros(F, np.int32)
+                for f, tab in enumerate(self.tables):
+                    if tab.kind == "num":
+                        thr[f, :len(tab.thresholds)] = tab.thresholds
+                        zero_bin[f] = np.searchsorted(tab.thresholds,
+                                                      0.0, "left")
+                    elif tab.kind == "cat" and len(tab.cats):
+                        cat_vals[f, :len(tab.cats)] = tab.cats
+                        cat_len[f] = len(tab.cats)
+                self._bin_dev = (jnp.asarray(thr),
+                                 jnp.asarray(zero_bin),
+                                 jnp.asarray(cat_vals),
+                                 jnp.asarray(cat_len))
+            return self._bin_dev
 
     # -- traversal ---------------------------------------------------------
     def leaf_ids(self, x: np.ndarray,
@@ -594,12 +614,16 @@ class PredictorEngine:
     # -- fused device-resident path ----------------------------------------
     def _fused_dev_arrays(self):
         import jax.numpy as jnp
-        if self._fused_dev is None:
-            self._fused_dev = (
-                jnp.asarray(self._leaf_f32),
-                jnp.asarray(self._w32),
-                jnp.asarray(np.float32(self._avg_denom)))
-        return self._fused_dev
+        dev = self._fused_dev
+        if dev is not None:
+            return dev          # lock-free fast path, published whole
+        with self._lock:        # build-once (see _device_bin_tables)
+            if self._fused_dev is None:
+                self._fused_dev = (
+                    jnp.asarray(self._leaf_f32),
+                    jnp.asarray(self._w32),
+                    jnp.asarray(np.float32(self._avg_denom)))
+            return self._fused_dev
 
     def _fused_call(self, xdev, transform):
         d = self._dev

@@ -54,7 +54,13 @@ class ServedModel:
     that the engine's byte-parity probe FAILED at load (as opposed to
     the engine being unsupported) — the continual promotion gate refuses
     such candidates outright where plain serving merely demotes them to
-    the host walk."""
+    the host walk.
+
+    Lock contract (tools/analyze/check_races.py):
+        _iflock guards: _inflight
+
+    Everything else on a ServedModel is immutable after registration
+    (``registry.load`` publishes it under the registry lock)."""
 
     __slots__ = ("version", "booster", "engine", "source", "loaded_at",
                  "self_check_failed", "sha256", "_inflight", "_iflock")
@@ -84,7 +90,12 @@ class ServedModel:
 
     @property
     def inflight(self) -> int:
-        return self._inflight
+        # locked read: a torn read is impossible for a GIL int, but the
+        # registry's eviction decision ("may I drop this version?")
+        # must observe a count that is current with respect to a
+        # concurrent begin_request, not a stale register
+        with self._iflock:
+            return self._inflight
 
     def describe(self) -> dict:
         return {"version": self.version, "source": self.source,
@@ -92,12 +103,24 @@ class ServedModel:
                 "num_trees": len(self.booster.trees),
                 "num_class": self.booster._num_tree_per_iteration,
                 "num_features": self.booster.num_feature(),
-                "inflight": self._inflight,
+                "inflight": self.inflight,
                 "fingerprint": self.engine.fingerprint
                 if self.engine is not None else None}
 
 
 class ModelRegistry:
+    """Versioned (version -> ServedModel) map with an atomic current
+    pointer (module docstring).
+
+    Lock contract (tools/analyze/check_races.py):
+        _lock guards: _models, _current, _next_version
+
+    ``_lock`` is leaf-level except for ``ServedModel._iflock``: the
+    eviction scan reads ``inflight`` (which takes ``_iflock``) while
+    holding ``_lock`` — that order (registry then model) is the ONLY
+    sanctioned nesting; ServedModel methods never call back into the
+    registry."""
+
     def __init__(self, *, max_batch: Optional[int] = None,
                  min_bucket: int = 16, build_engine: bool = True,
                  verify_artifacts: bool = True,

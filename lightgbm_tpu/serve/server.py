@@ -72,7 +72,23 @@ from .registry import ArtifactVerificationError, ModelRegistry, NoModelError
 
 
 class Server:
-    """Long-lived in-process prediction service."""
+    """Long-lived in-process prediction service.
+
+    Thread topology: HTTP handler threads (ThreadingHTTPServer) call
+    ``submit``/``reload``/``promote``/``health``/``metrics_snapshot``
+    concurrently; the batcher worker thread calls ``_predict_batch``;
+    a ContinualTrainer may call ``promote``/``shadow_batches`` from its
+    own loop thread.
+
+    Lock contract (tools/analyze/check_races.py):
+        _lock guards: _versions_loaded, _closed
+        registry type: lightgbm_tpu/serve/registry.py:ModelRegistry
+        batcher type: lightgbm_tpu/serve/batcher.py:MicroBatcher
+        breaker type: lightgbm_tpu/serve/breaker.py:ServeBreaker
+
+    ``_shadow_ring`` is deliberately lock-free: deque appends are
+    atomic under the GIL and ``shadow_batches`` snapshots via
+    ``list()``; the ring holds references only."""
 
     def __init__(self, params: Optional[Dict[str, Any]] = None,
                  booster=None, model_file: Optional[str] = None,
@@ -99,7 +115,9 @@ class Server:
             max_resident=cfg.serve_max_resident)
         # versions EVER activated (not currently registered — unload()
         # can hide history): gates the perf.forest achieved-rate join,
-        # whose all-time rows/latency counters only describe one model
+        # whose all-time rows/latency counters only describe one model.
+        # Written from HTTP handler threads (reload/promote) — guarded
+        self._lock = threading.Lock()
         self._versions_loaded = 0
         model_file = model_file or (cfg.input_model or None)
         if booster is not None or model_file or model_str:
@@ -250,7 +268,8 @@ class Server:
         except BaseException:
             self.metrics.counter("serve.reload_failures").inc()
             raise
-        self._versions_loaded += 1
+        with self._lock:        # reload/promote race from HTTP threads
+            self._versions_loaded += 1
         Log.info(f"serve: activated model {version}")
         return version
 
@@ -284,7 +303,8 @@ class Server:
             # call (bad args, missing file) is not
             self.metrics.counter("continual.rollbacks").inc()
             raise
-        self._versions_loaded += 1
+        with self._lock:
+            self._versions_loaded += 1
         self.metrics.counter("continual.published").inc()
         Log.info(f"serve: gated promotion activated model {v}")
         return v, gate
@@ -311,9 +331,10 @@ class Server:
         }
         ct = self.continual
         if ct is not None:
-            out["generation"] = ct.generation
-            out["freshness_lag_s"] = ct.freshness_lag_s(now)
-            out["last_publish"] = dict(ct.last_publish) or None
+            # ONE-lock snapshot: three separate field reads would let a
+            # promote land in between and report generation N next to
+            # generation N+1's publish record
+            out.update(ct.freshness_snapshot(now))
         else:
             # no trainer attached: the model's age IS the only lag
             # signal this replica has
@@ -423,7 +444,9 @@ class Server:
                 # intensity/bound are per-row ratios — always valid
                 for k, v in roofline(fl, hb, 0, pf, pb).items():
                     snap[f"perf.forest.{k}"] = v
-                if self._versions_loaded <= 1:
+                with self._lock:
+                    versions_loaded = self._versions_loaded
+                if versions_loaded <= 1:
                     for k, v in roofline(fl * rows, hb * rows, secs,
                                          pf, pb).items():
                         snap[f"perf.forest.{k}"] = v
@@ -437,9 +460,10 @@ class Server:
         return snap
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        with self._lock:        # close-once latch: two racing closers
+            if self._closed:    # must not double-close the sinks
+                return
+            self._closed = True
         self.batcher.close()
         if self.recorder is not None:
             self.recorder.close()

@@ -90,20 +90,17 @@ class TestWarmStart:
 class TestRetraceLint:
     """The lint re-runs the whole canonical matrix in a fresh
     subprocess (~15 s with a warm persistent cache — which tier-1's own
-    earlier compiles populate — minutes stone-cold).  The green run is
-    tier-1 (the retrace budget next to the sync lint, ISSUE 6); the
-    tamper/stale sensitivity re-run is slow-marked."""
+    earlier compiles populate — minutes stone-cold).  The GREEN run now
+    rides the unified driver (`python tools/lint.py`,
+    tests/test_zlint.py — ISSUE 12 replaced the separate sync/retrace
+    invocations); this class keeps the standalone entry point's
+    tamper/stale sensitivity, slow-marked."""
 
     def _run(self, *args, timeout=600):
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         return subprocess.run([sys.executable, LINT, *args],
                               capture_output=True, text=True,
                               timeout=timeout, env=env, cwd=REPO)
-
-    def test_green_against_pinned_budget(self):
-        out = self._run()
-        assert out.returncode == 0, out.stdout + out.stderr
-        assert "retrace lint: clean" in out.stdout
 
     @pytest.mark.slow
     def test_tampered_budget_is_caught(self, tmp_path):

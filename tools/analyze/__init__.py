@@ -1,0 +1,14 @@
+"""Static-analysis suite (docs/Static-Analysis.md).
+
+AST-based lints in the check_syncs/check_retraces mold, run in tier-1
+through the unified driver ``tools/lint.py``:
+
+- ``check_races``  — lock-discipline race lint for the threaded
+  serve/continual stack (guard-map inference, unguarded-access and
+  multi-writer findings, static lock-order deadlock detection);
+- ``check_purity`` — jit-purity lint for every function reachable
+  inside a traced body (host side effects that would escape a tracer);
+- ``lintlib``      — the shared allowlist/pin parser, stale-entry
+  detection and finding plumbing the whole lint family
+  (syncs, retraces, races, purity) is built on.
+"""

@@ -1,0 +1,652 @@
+"""Lock-discipline race lint for the threaded serve/continual stack.
+
+The reference C++ LightGBM leans on compile-time types and yamc
+rwlocks for its thread-safety story; this Python/JAX rebuild has
+neither, yet PRs 5-9 grew a genuinely concurrent production surface —
+the batcher worker thread, hot-swap registry with in-flight counters,
+circuit breaker, drain, the continual shadow-probe thread — where a
+single unguarded field read is a silent corruption bug no tier-1 test
+deterministically catches.  This lint keeps the lock discipline true
+STRUCTURALLY, in the check_syncs/check_retraces mold:
+
+For each threaded module (``THREADED_MODULES``, plus any module whose
+classes own a ``threading.Lock``/``RLock``/``Condition``), per class:
+
+1. **Guard-map inference.**  A ``self._x`` attribute WRITTEN inside a
+   ``with self._lock:`` block (in any non-``__init__`` method,
+   including private helpers only ever called with the lock held —
+   call contexts propagate through same-class calls) is *guarded by*
+   that lock.  Class docstrings can pin or disambiguate the map with
+   lock-contract annotations::
+
+       Lock contract (tools/analyze/check_races.py):
+           _lock guards: _queue, _depth_rows
+           breaker type: lightgbm_tpu/serve/breaker.py:ServeBreaker
+
+   A ``guards:`` line declares attributes guarded even where inference
+   alone is ambiguous; a ``type:`` line names the class behind an
+   attribute so cross-object lock acquisitions feed the lock-order
+   graph.  Contract lines that match nothing are STALE and fail the
+   lint, like every pin in the family.
+2. **Findings.**  (a) any read/write of a guarded attribute on a code
+   path that does not hold its lock; (b) attributes mutated from more
+   than one method with no lock at all (multi-writer, zero guards);
+   (c) lock-acquisition-order cycles across classes/modules (static
+   deadlock detection over the nested-``with`` + cross-object call
+   graph; a non-reentrant lock re-acquired on one path is a self-cycle).
+3. **Allowlist.**  Intentional lock-free accesses are pinned in
+   ``tools/race_allowlist.txt`` as
+   ``path | Class.method | attribute | rationale`` (rationale
+   MANDATORY; ``Class`` alone pins a multi-writer finding).  Stale
+   entries are errors.
+
+Construction (``__init__`` and everything it calls) is exempt:
+publication of ``self`` happens-after construction.  The analysis is
+deliberately first-order — ``self.attr`` accesses only; aliasing
+through locals and foreign objects is out of scope (the lint is a
+discipline gate, not a verifier).
+
+Run via ``python tools/lint.py`` (tier-1), or standalone
+(``python tools/analyze/check_races.py``; exit 1 on findings).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+if __package__:
+    from . import lintlib
+else:                                        # standalone execution
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lintlib
+
+REPO = lintlib.REPO
+PACKAGE = lintlib.PACKAGE
+ALLOWLIST = os.path.join(REPO, "tools", "race_allowlist.txt")
+
+# the threaded production surface (paths inside the package root);
+# modules that own locks are pulled in automatically on top
+THREADED_MODULES = (
+    "serve/batcher.py",
+    "serve/registry.py",
+    "serve/server.py",
+    "serve/engine.py",
+    "serve/breaker.py",
+    "pipeline/continual.py",
+    "utils/resilience.py",
+)
+
+# container methods that mutate their receiver: self._q.append(x) is a
+# WRITE to the structure _q names, not just a read of the reference
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop",
+             "popleft", "popitem", "remove", "clear", "add", "discard",
+             "update", "setdefault", "sort", "reverse"}
+
+_LOCK_FACTORIES = {"Lock", "RLock"}
+
+_GUARDS_RE = re.compile(r"^\s*(\w+) guards:\s*(.+?)\s*$")
+_TYPE_RE = re.compile(r"^\s*(\w+) type:\s*(\S+?):(\w+)\s*$")
+
+Held = FrozenSet[str]
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "held", "lineno", "method")
+
+    def __init__(self, attr: str, kind: str, held: Held, lineno: int,
+                 method: str):
+        self.attr, self.kind, self.held = attr, kind, held
+        self.lineno, self.method = lineno, method
+
+
+class _Method:
+    def __init__(self, name: str):
+        self.name = name
+        self.accesses: List[_Access] = []
+        # (callee method name, held at call, lineno)
+        self.self_calls: List[Tuple[str, Held, int]] = []
+        # (self-attr the call goes through, callee name, held, lineno)
+        self.foreign_calls: List[Tuple[str, str, Held, int]] = []
+        # direct `with self.<lock>` acquisitions: (lock, held before)
+        self.acquisitions: List[Tuple[str, Held, int]] = []
+        self.escapes = False     # referenced without a call (callback)
+
+
+class _Class:
+    def __init__(self, rel: str, name: str):
+        self.rel, self.name = rel, name
+        self.locks: Dict[str, str] = {}      # lock attr -> "lock"|"rlock"
+        self.alias: Dict[str, str] = {}      # Condition attr -> lock attr
+        self.methods: Dict[str, _Method] = {}
+        self.properties: Set[str] = set()
+        self.decl_guards: Dict[str, str] = {}        # attr -> lock
+        self.attr_types: Dict[str, Tuple[str, str]] = {}
+        self.decl_lines: Dict[str, int] = {}
+
+    def lock_of(self, attr: str) -> Optional[str]:
+        attr = self.alias.get(attr, attr)
+        return attr if attr in self.locks else None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_factory(call: ast.AST) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when ``call`` constructs one."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else None)
+    if name in _LOCK_FACTORIES:
+        return "rlock" if name == "RLock" else "lock"
+    if name == "Condition":
+        return "condition"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-method AST walk
+# ---------------------------------------------------------------------------
+
+class _MethodWalker:
+    """Walks one method body tracking the held-lock set through
+    ``with self.<lock>:`` blocks, recording every ``self.<attr>``
+    access, same-class call, and cross-object call."""
+
+    def __init__(self, cls: _Class, minfo: _Method):
+        self.cls, self.m = cls, minfo
+
+    def walk_body(self, body, held: Held) -> None:
+        for stmt in body:
+            self.walk(stmt, held)
+
+    def walk(self, node: ast.AST, held: Held) -> None:
+        cls, m = self.cls, self.m
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newheld = set(held)
+            for item in node.items:
+                a = _self_attr(item.context_expr)
+                lk = cls.lock_of(a) if a else None
+                if lk is not None:
+                    m.acquisitions.append((lk, held, node.lineno))
+                    newheld.add(lk)
+                else:
+                    self.walk(item.context_expr, held)
+            self.walk_body(node.body, frozenset(newheld))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function/closure: runs on the same thread, in the
+            # enclosing method's protocol — attribute its accesses here
+            # with the held set at the definition site (the common
+            # define-then-run-synchronously pattern; a closure handed
+            # to another THREAD is exactly what the lint should flag)
+            for d in node.decorator_list:
+                self.walk(d, held)
+            self.walk_body(node.body, held)
+            return
+        if isinstance(node, ast.Lambda):
+            self.walk(node.body, held)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            a = _self_attr(f)
+            if a is not None:
+                if cls.lock_of(a) is not None:
+                    pass           # lock-object call (.acquire handled
+                    #                conservatively as opaque)
+                elif a in cls.methods:
+                    m.self_calls.append((a, held, node.lineno))
+                else:
+                    # calling a stored callable: a read of the attr
+                    m.accesses.append(_Access(a, "read", held,
+                                              node.lineno, m.name))
+            elif isinstance(f, ast.Attribute):
+                base_attr = _self_attr(f.value)
+                if base_attr is not None:
+                    if cls.lock_of(base_attr) is not None:
+                        pass       # condition/lock method: wait/notify
+                    else:
+                        m.accesses.append(_Access(
+                            base_attr, "read", held, node.lineno,
+                            m.name))
+                        if f.attr in _MUTATORS:
+                            m.accesses.append(_Access(
+                                base_attr, "mutate", held, node.lineno,
+                                m.name))
+                        m.foreign_calls.append(
+                            (base_attr, f.attr, held, node.lineno))
+                else:
+                    self.walk(f, held)
+            else:
+                self.walk(f, held)
+            for arg in node.args:
+                self.walk(arg, held)
+            for kw in node.keywords:
+                self.walk(kw.value, held)
+            return
+        if isinstance(node, ast.AugAssign):
+            a = _self_attr(node.target)
+            if a is not None and cls.lock_of(a) is None:
+                m.accesses.append(_Access(a, "read", held,
+                                          node.lineno, m.name))
+                m.accesses.append(_Access(a, "write", held,
+                                          node.lineno, m.name))
+            else:
+                self.walk(node.target, held)
+            self.walk(node.value, held)
+            return
+        if isinstance(node, ast.Subscript):
+            a = _self_attr(node.value)
+            if a is not None and cls.lock_of(a) is None:
+                kind = "read" if isinstance(node.ctx, ast.Load) \
+                    else "mutate"
+                m.accesses.append(_Access(a, "read", held,
+                                          node.lineno, m.name))
+                if kind == "mutate":
+                    m.accesses.append(_Access(a, "mutate", held,
+                                              node.lineno, m.name))
+            else:
+                self.walk(node.value, held)
+            self.walk(node.slice, held)
+            return
+        if isinstance(node, ast.Attribute):
+            a = _self_attr(node)
+            if a is not None:
+                if cls.lock_of(a) is not None:
+                    return
+                if a in cls.methods:
+                    if a in cls.properties:
+                        # property access executes the getter inline
+                        m.self_calls.append((a, held, node.lineno))
+                    elif isinstance(node.ctx, ast.Load):
+                        # bound method escaping (thread target,
+                        # callback): the callee must assume NO lock
+                        cls.methods[a].escapes = True
+                    return
+                kind = "read" if isinstance(node.ctx, ast.Load) \
+                    else "write"
+                m.accesses.append(_Access(a, kind, held, node.lineno,
+                                          m.name))
+                return
+            self.walk(node.value, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.walk(child, held)
+
+
+# ---------------------------------------------------------------------------
+# module / class harvesting
+# ---------------------------------------------------------------------------
+
+def _parse_contract(cls: _Class, doc: Optional[str],
+                    lineno: int) -> List[str]:
+    """Lock-contract annotations from the class docstring; returns
+    malformed-directive findings."""
+    findings: List[str] = []
+    if not doc:
+        return findings
+    for line in doc.splitlines():
+        mg = _GUARDS_RE.match(line)
+        if mg:
+            lock, attrs = mg.group(1), mg.group(2)
+            if cls.lock_of(lock) is None:
+                findings.append(
+                    f"{cls.rel}:{lineno}: {cls.name}: lock contract "
+                    f"names unknown lock '{lock}' (class owns: "
+                    f"{sorted(cls.locks) or 'none'})")
+                continue
+            for attr in [a.strip() for a in attrs.split(",")]:
+                if attr:
+                    cls.decl_guards[attr] = cls.lock_of(lock)
+                    cls.decl_lines[attr] = lineno
+            continue
+        mt = _TYPE_RE.match(line)
+        if mt:
+            cls.attr_types[mt.group(1)] = (mt.group(2), mt.group(3))
+    return findings
+
+
+def harvest(root: str) -> Tuple[Dict[Tuple[str, str], _Class],
+                                List[str]]:
+    """Parse every module under ``root``; returns
+    ``{(rel, classname): _Class}`` plus parse/contract findings."""
+    classes: Dict[Tuple[str, str], _Class] = {}
+    findings: List[str] = []
+    for path in lintlib.iter_py(root):
+        rel = lintlib.rel_to_root(path, root)
+        try:
+            with open(path, "rb") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            findings.append(f"{rel}: unparseable ({e})")
+            continue
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _Class(rel, node.name)
+            # pass 1: locks, aliases, method inventory
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    cls.methods[sub.name] = _Method(sub.name)
+                    for d in sub.decorator_list:
+                        dn = d.id if isinstance(d, ast.Name) else (
+                            d.attr if isinstance(d, ast.Attribute)
+                            else None)
+                        if dn in ("property", "cached_property",
+                                  "setter", "getter"):
+                            cls.properties.add(sub.name)
+            for fn in [s for s in node.body
+                       if isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]:
+                for stmt in ast.walk(fn):
+                    if not isinstance(stmt, ast.Assign):
+                        continue
+                    kind = _lock_factory(stmt.value)
+                    if kind is None:
+                        continue
+                    for tgt in stmt.targets:
+                        a = _self_attr(tgt)
+                        if a is None:
+                            continue
+                        if kind == "condition":
+                            arg = stmt.value.args[0] \
+                                if stmt.value.args else None
+                            wrapped = _self_attr(arg) \
+                                if arg is not None else None
+                            if wrapped:
+                                cls.alias[a] = wrapped
+                            else:
+                                cls.locks[a] = "lock"
+                        else:
+                            cls.locks[a] = kind
+            findings.extend(_parse_contract(cls, ast.get_docstring(node),
+                                            node.lineno))
+            # pass 2: walk method bodies
+            for fn in [s for s in node.body
+                       if isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]:
+                if fn.name == "__init__":
+                    continue     # construction happens-before publish
+                _MethodWalker(cls, cls.methods[fn.name]) \
+                    .walk_body(fn.body, frozenset())
+            classes[(rel, node.name)] = cls
+    return classes, findings
+
+
+# ---------------------------------------------------------------------------
+# call-context propagation (so `_trip_locked`-style helpers inherit the
+# caller's held set instead of being flagged as unguarded)
+# ---------------------------------------------------------------------------
+
+def _entry_contexts(cls: _Class) -> Dict[str, Set[Held]]:
+    ctx: Dict[str, Set[Held]] = {m: set() for m in cls.methods}
+    internal_callees = {c for m in cls.methods.values()
+                        for (c, _h, _l) in m.self_calls}
+    for name, m in cls.methods.items():
+        public = not name.startswith("_") or (
+            name.startswith("__") and name.endswith("__"))
+        if public or m.escapes or name not in internal_callees:
+            ctx[name].add(frozenset())
+    for _ in range(len(cls.methods) + 2):       # fixed point (held sets
+        changed = False                          # only grow)
+        for name, m in cls.methods.items():
+            for callee, held, _ln in m.self_calls:
+                for c in ctx[name]:
+                    nc = c | held
+                    if nc not in ctx[callee]:
+                        ctx[callee].add(nc)
+                        changed = True
+        if not changed:
+            break
+    for name in ctx:                             # dead private methods
+        if not ctx[name]:
+            ctx[name].add(frozenset())
+    return ctx
+
+
+def _effective(cls: _Class) -> List[_Access]:
+    """Accesses with call contexts folded in: one access per
+    (site, entry context)."""
+    ctx = _entry_contexts(cls)
+    out: List[_Access] = []
+    for name, m in cls.methods.items():
+        for acc in m.accesses:
+            for c in ctx[name]:
+                out.append(_Access(acc.attr, acc.kind, acc.held | c,
+                                   acc.lineno, name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock-order graph (static deadlock detection)
+# ---------------------------------------------------------------------------
+
+def _lock_events(classes: Dict[Tuple[str, str], _Class]
+                 ) -> Dict[Tuple[str, str, str],
+                           Set[Tuple[Tuple[str, str, str], Held]]]:
+    """Per (rel, Class, method): the set of lock-acquisition events
+    ``(lock node, frozenset of SAME-CLASS locks held when acquiring)``
+    reachable from it — own ``with`` blocks plus same-class and typed
+    cross-object calls, to a fixed point."""
+    events: Dict[Tuple[str, str, str],
+                 Set[Tuple[Tuple[str, str, str], Held]]] = {}
+    for (rel, cname), cls in classes.items():
+        for mname, m in cls.methods.items():
+            ev = set()
+            for lk, held, _ln in m.acquisitions:
+                ev.add(((rel, cname, lk), held))
+            events[(rel, cname, mname)] = ev
+
+    def _callee_keys(cls: _Class, m: _Method):
+        for callee, held, _ln in m.self_calls:
+            yield (cls.rel, cls.name, callee), held
+        for attr, callee, held, _ln in m.foreign_calls:
+            tgt = cls.attr_types.get(attr)
+            if tgt and (tgt[0], tgt[1]) in classes:
+                tcls = classes[(tgt[0], tgt[1])]
+                if callee in tcls.methods:
+                    yield (tgt[0], tgt[1], callee), held
+
+    for _ in range(len(events) + 2):
+        changed = False
+        for (rel, cname), cls in classes.items():
+            for mname, m in cls.methods.items():
+                key = (rel, cname, mname)
+                for ckey, held in _callee_keys(cls, m):
+                    for node, _h in events.get(ckey, ()):
+                        item = (node, held)
+                        if item not in events[key]:
+                            events[key].add(item)
+                            changed = True
+        if not changed:
+            break
+    return events
+
+
+def lock_order_findings(classes: Dict[Tuple[str, str], _Class]
+                        ) -> List[str]:
+    events = _lock_events(classes)
+    edges: Dict[Tuple[str, str, str], Set[Tuple[str, str, str]]] = {}
+    for (rel, cname, _m), evs in events.items():
+        cls = classes[(rel, cname)]
+        for node, held in evs:
+            for h in held:
+                src = (rel, cname, h)
+                if src == node and cls.locks.get(h) == "rlock":
+                    continue               # reentrant: self-edge fine
+                edges.setdefault(src, set()).add(node)
+    findings: List[str] = []
+
+    def fmt(n):
+        return f"{n[0]}:{n[1]}.{n[2]}"
+
+    # self-loops: a non-reentrant lock re-acquired while held
+    for src, dsts in sorted(edges.items()):
+        if src in dsts:
+            findings.append(
+                f"lock-order: non-reentrant lock {fmt(src)} acquired "
+                "while already held (self-deadlock)")
+    # cycles across locks: recursive coloring DFS (lock graphs are tiny)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in
+             set(edges) | {d for ds in edges.values() for d in ds}}
+    seen_cycles: Set[Tuple] = set()
+
+    def dfs(n, path):
+        color[n] = GRAY
+        for nxt in sorted(edges.get(n, ())):
+            if nxt == n:
+                continue
+            if color.get(nxt, WHITE) == GRAY:
+                cyc = path[path.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    findings.append(
+                        "lock-order cycle (potential deadlock): "
+                        + " -> ".join(fmt(c) for c in cyc))
+            elif color.get(nxt, WHITE) == WHITE:
+                dfs(nxt, path + [nxt])
+        color[n] = BLACK
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            dfs(n, [n])
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the lint
+# ---------------------------------------------------------------------------
+
+def run(root: str = PACKAGE, allowlist_path: str = ALLOWLIST,
+        modules: Optional[List[str]] = None) -> List[str]:
+    """The full race lint; returns findings (empty = green)."""
+    classes, findings = harvest(root)
+    allow = lintlib.load_pin_keys(allowlist_path)
+    used: Set[Tuple[str, str, str]] = set()
+    threaded = set(modules if modules is not None else THREADED_MODULES)
+    pkg = os.path.basename(os.path.abspath(root))
+    report_rels = {f"{pkg}/{m}" for m in threaded} | {
+        rel for (rel, _c), cls in classes.items() if cls.locks}
+
+    def pinned(rel: str, scope: str, attr: str) -> bool:
+        key = (rel, scope, attr)
+        if key in allow:
+            used.add(key)
+            return True
+        return False
+
+    for (rel, cname), cls in sorted(classes.items()):
+        if rel not in report_rels:
+            continue
+        eff = _effective(cls)
+        by_attr: Dict[str, List[_Access]] = {}
+        for acc in eff:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        # stale lock-contract guards: a declared attr no method touches
+        for attr, lk in sorted(cls.decl_guards.items()):
+            if attr not in by_attr:
+                findings.append(
+                    f"{rel}:{cls.decl_lines.get(attr, 0)}: {cname}: "
+                    f"stale lock contract — '{attr}' (declared guarded "
+                    f"by '{lk}') is never accessed")
+        # stale type lines: an unresolvable target (or an attribute no
+        # method touches) silently DROPS edges from the deadlock graph,
+        # so contract rot here must fail the lint like everywhere else
+        for attr, tgt in sorted(cls.attr_types.items()):
+            if (tgt[0], tgt[1]) not in classes:
+                findings.append(
+                    f"{rel}: {cname}: stale lock contract — "
+                    f"'{attr} type: {tgt[0]}:{tgt[1]}' resolves to no "
+                    "analyzed class (renamed/moved?); its lock-order "
+                    "edges are lost")
+            elif attr not in by_attr:
+                findings.append(
+                    f"{rel}: {cname}: stale lock contract — typed "
+                    f"attribute '{attr}' is never accessed")
+        for attr, accs in sorted(by_attr.items()):
+            # guard inference: any lock held across a write establishes
+            # a guard candidate
+            inferred: Set[str] = set()
+            for acc in accs:
+                if acc.kind in ("write", "mutate"):
+                    inferred |= acc.held
+            declared = cls.decl_guards.get(attr)
+            if declared is not None:
+                guard: Optional[str] = declared
+            elif len(inferred) == 1:
+                guard = next(iter(inferred))
+            elif len(inferred) > 1:
+                if not pinned(rel, cname, attr):
+                    findings.append(
+                        f"{rel}: {cname}: ambiguous guard for "
+                        f"'{attr}' — written under "
+                        f"{sorted(inferred)}; disambiguate with a "
+                        f"lock-contract 'X guards: {attr}' line")
+                continue
+            else:
+                guard = None
+            if guard is not None:
+                # rule (a): every access must hold the guard
+                for acc in accs:
+                    if guard in acc.held:
+                        continue
+                    scope = f"{cname}.{acc.method}"
+                    if pinned(rel, scope, attr):
+                        continue
+                    findings.append(
+                        f"{rel}:{acc.lineno}: {scope}: {acc.kind} of "
+                        f"'{attr}' outside its guard 'self.{guard}'")
+            else:
+                # rule (b): unguarded multi-writer
+                writers = {acc.method for acc in accs
+                           if acc.kind in ("write", "mutate")}
+                if len(writers) > 1 and not pinned(rel, cname, attr):
+                    sites = sorted(
+                        {f"{acc.method}:{acc.lineno}" for acc in accs
+                         if acc.kind in ("write", "mutate")})
+                    findings.append(
+                        f"{rel}: {cname}: '{attr}' mutated from "
+                        f"{len(writers)} methods with no lock "
+                        f"({', '.join(sites)})")
+    findings.extend(lock_order_findings(
+        {k: v for k, v in classes.items() if k[0] in report_rels
+         or v.locks or v.attr_types}))
+    findings.extend(lintlib.stale_pins(allow, used, "race allowlist"))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=PACKAGE)
+    ap.add_argument("--allowlist", default=ALLOWLIST)
+    args = ap.parse_args(argv)
+    findings = run(args.root, args.allowlist)
+    if findings:
+        print("race lint: lock-discipline violations:", file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        print(f"\n{len(findings)} finding(s).  Take the lock, declare "
+              "the contract in the class docstring, or pin an "
+              "intentional lock-free access in tools/race_allowlist.txt "
+              "(rationale required)", file=sys.stderr)
+        return 1
+    print("race lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""Shared plumbing for the lint family (tools/lint.py driver).
+
+Every lint in the family (sync, retrace, race, purity) has the same
+skeleton: measure the tree, compare against a PIN FILE, report
+findings including entries that no longer match anything (stale pins —
+the mechanism that keeps pin files from rotting), exit 1 on any
+finding.  This module is that skeleton, factored out of
+``check_syncs.py`` / ``check_retraces.py`` so the two new AST lints
+(``check_races.py`` / ``check_purity.py``) don't grow a third and
+fourth copy of the parsing:
+
+- ``parse_pins``      — ``|``-separated pin entries with an optional
+  MANDATORY-rationale tail field (race/purity allowlists demand a
+  reason per pin; the sync allowlist carries reasons as comments);
+- ``stale_pins``      — the shared stale-entry findings;
+- ``load_kv_int`` / ``write_kv_int`` — ``key = int`` budget files
+  (retrace budget) with ``--update`` re-pinning;
+- ``code_lines``      — tokenize-based comment/string blanking so
+  docs may mention linted constructs freely;
+- ``iter_py`` / ``rel_to_root`` — tree walking with the path
+  convention shared by every pass (paths relative to the PARENT of
+  the scanned package root, so a package copied to a temp dir for a
+  tamper test matches the same allowlist entries as the real tree).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tokenize
+from typing import Dict, Iterator, List, Sequence, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PACKAGE = os.path.join(REPO, "lightgbm_tpu")
+
+
+# ---------------------------------------------------------------------------
+# pin files
+# ---------------------------------------------------------------------------
+
+def parse_pins(path: str, fields: int,
+               require_rationale: bool = False
+               ) -> List[Tuple[Tuple[str, ...], str]]:
+    """Parse a ``|``-separated pin file: ``fields`` leading fields plus
+    (when ``require_rationale``) one trailing rationale field.  Returns
+    ``[(fields_tuple, rationale), ...]``; blank lines and ``#`` comments
+    are skipped.  A rationale-bearing entry whose rationale is empty is
+    a malformed pin and raises — an allowlist exists to record WHY each
+    exemption is safe, and a bare pin defeats that."""
+    out: List[Tuple[Tuple[str, ...], str]] = []
+    try:
+        f = open(path)
+    except OSError:
+        return out
+    with f:
+        for lineno, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw or raw.startswith("#"):
+                continue
+            want = fields + (1 if require_rationale else 0)
+            parts = [p.strip() for p in raw.split("|", want - 1)]
+            if len(parts) < want or (require_rationale
+                                     and not parts[-1]):
+                raise ValueError(
+                    f"{path}:{lineno}: malformed pin (need {fields} "
+                    f"'|'-separated fields"
+                    + (" + a non-empty rationale" if require_rationale
+                       else "") + f"): {raw!r}")
+            key = tuple(parts[:fields])
+            rationale = parts[fields] if require_rationale else ""
+            out.append((key, rationale))
+    return out
+
+
+def load_pin_keys(path: str, fields: int = 3,
+                  require_rationale: bool = True
+                  ) -> Set[Tuple[str, ...]]:
+    """The race/purity allowlist form of :func:`parse_pins`: keys only,
+    rationale mandatory."""
+    return {key for key, _ in parse_pins(
+        path, fields, require_rationale=require_rationale)}
+
+
+def stale_pins(allow: Set[Tuple[str, ...]], used: Set[Tuple[str, ...]],
+               label: str) -> List[str]:
+    """The shared stale-entry findings: every pin that suppressed
+    nothing this run is reported, so pin files cannot rot."""
+    return [f"stale {label} entry (no matching finding): "
+            + " | ".join(key) for key in sorted(allow - used)]
+
+
+# ---------------------------------------------------------------------------
+# key = int budget files (retrace budget)
+# ---------------------------------------------------------------------------
+
+def load_kv_int(path: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.split("#")[0].strip()
+                if not raw or "=" not in raw:
+                    continue
+                k, _, v = raw.partition("=")
+                out[k.strip()] = int(v.strip())
+    except OSError:
+        pass
+    return out
+
+
+def write_kv_int(measured: Dict[str, int], path: str,
+                 header: Sequence[str]) -> None:
+    lines = list(header) + [""]
+    for k in sorted(measured):
+        lines.append(f"{k} = {measured[k]}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# source walking
+# ---------------------------------------------------------------------------
+
+def iter_py(root: str) -> Iterator[str]:
+    """Every ``.py`` under ``root``, ``__pycache__`` pruned, sorted for
+    deterministic finding order."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def rel_to_root(path: str, root: str) -> str:
+    """Path convention of the whole family: relative to the PARENT of
+    the scanned package root.  For the real tree that is the repo root
+    (``lightgbm_tpu/serve/batcher.py``); for a package copied to a temp
+    dir (tamper tests) the SAME relative path comes out, so the real
+    allowlists keep matching."""
+    return os.path.relpath(path, os.path.dirname(os.path.abspath(root)))
+
+
+def code_lines(path: str) -> Dict[int, str]:
+    """line number -> source line, with comment and string tokens
+    blanked out so docs/docstrings never trigger a text lint."""
+    with open(path, "rb") as f:
+        src = f.read()
+    text = src.decode("utf-8")
+    lines = text.splitlines()
+    drop: List[Tuple[int, int, int, int]] = []
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type in (tokenize.COMMENT, tokenize.STRING):
+                drop.append((*tok.start, *tok.end))
+    except tokenize.TokenError:
+        pass                     # partial file: lint what parsed
+    out = {i + 1: ln for i, ln in enumerate(lines)}
+    for (r0, c0, r1, c1) in drop:
+        for r in range(r0, r1 + 1):
+            ln = out.get(r, "")
+            a = c0 if r == r0 else 0
+            b = c1 if r == r1 else len(ln)
+            out[r] = ln[:a] + " " * (b - a) + ln[b:]
+    return out

@@ -24,10 +24,11 @@ structurally:
   leaf sweep) must EXCEED the bucketed budget — proving the lint
   would catch a bucketing regression, not just rubber-stamp it.
 
-Run standalone (``python tools/check_retraces.py``; exit 1 on
-findings; ``--update`` rewrites the budget file) or via tier-1
-(tests/test_zretrace.py::TestRetraceLint runs it in a fresh
-subprocess).
+Run via the unified driver (``python tools/lint.py``; tier-1) or
+standalone (``python tools/check_retraces.py``; exit 1 on findings;
+``--update`` rewrites the budget file).  Budget parsing and stale-entry
+detection live in ``tools/analyze/lintlib.py``, shared with the
+sync/race/purity lints.
 """
 
 from __future__ import annotations
@@ -37,7 +38,10 @@ import os
 import sys
 from typing import Dict, List
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from analyze import lintlib                              # noqa: E402
+
+REPO = lintlib.REPO
 BUDGET = os.path.join(REPO, "tools", "retrace_budget.txt")
 sys.path.insert(0, REPO)
 
@@ -185,34 +189,18 @@ def run_matrix() -> Dict[str, int]:
 
 
 def load_budget(path: str = BUDGET) -> Dict[str, int]:
-    out: Dict[str, int] = {}
-    try:
-        with open(path) as f:
-            for raw in f:
-                raw = raw.split("#")[0].strip()
-                if not raw or "=" not in raw:
-                    continue
-                k, _, v = raw.partition("=")
-                out[k.strip()] = int(v.strip())
-    except OSError:
-        pass
-    return out
+    return lintlib.load_kv_int(path)
 
 
 def write_budget(measured: Dict[str, int], path: str = BUDGET) -> None:
-    lines = [
+    lintlib.write_kv_int(measured, path, [
         "# Retrace budget (tools/check_retraces.py): EXACT number of",
         "# library jit traces per canonical scenario, counted via",
         "# jax.monitoring /lgbtpu/trace/* events on CPU.  A failing",
         "# entry means a retrace regression (or an intentional trace-",
         "# family change: re-pin with `python tools/check_retraces.py",
         "# --update` and justify the diff in review).",
-        "",
-    ]
-    for k in sorted(measured):
-        lines.append(f"{k} = {measured[k]}")
-    with open(path, "w") as f:
-        f.write("\n".join(lines) + "\n")
+    ])
 
 
 def check(measured: Dict[str, int],
@@ -226,9 +214,9 @@ def check(measured: Dict[str, int],
             findings.append(
                 f"trace budget violated: {k} = {measured[k]}, "
                 f"pinned {budget[k]}")
-    for k in sorted(set(budget) - set(measured)):
-        findings.append(f"stale budget entry (scenario no longer "
-                        f"produces it): {k} = {budget[k]}")
+    findings.extend(lintlib.stale_pins(
+        {(k,) for k in budget},
+        {(k,) for k in budget if k in measured}, "budget"))
     # co-hosting invariant (ISSUE 10): the second model version of one
     # family must hit the first one's compile-cache entries — ANY trace
     # during its storm is a shape-sharing regression
@@ -251,6 +239,30 @@ def check(measured: Dict[str, int],
     return findings
 
 
+def run_lint(budget_path: str = BUDGET, update: bool = False,
+             verbose: bool = True) -> List[str]:
+    """Measure the canonical matrix and check (or, with ``update``,
+    re-pin) the budget; the driver-facing entry point.  Forces CPU the
+    supported way (the axon sitecustomize freezes jax_platforms at
+    interpreter start; the env var is too late — same pattern as
+    bench.py / tests/conftest.py) unless LGBTPU_RETRACE_DEVICE says
+    otherwise."""
+    import jax
+    if os.environ.get("LGBTPU_RETRACE_DEVICE", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    _install_listener()
+    measured = run_matrix()
+    if verbose:
+        print("measured trace counters:")
+        for k in sorted(measured):
+            print(f"  {k} = {measured[k]}")
+    if update:
+        write_budget(measured, budget_path)
+        print(f"pinned {len(measured)} counters to {budget_path}")
+        return []
+    return check(measured, load_budget(budget_path))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--update", action="store_true",
@@ -258,26 +270,7 @@ def main() -> int:
     ap.add_argument("--budget", default=BUDGET,
                     help="budget file (tests point this at a temp copy)")
     args = ap.parse_args()
-
-    # force CPU the supported way (the axon sitecustomize freezes
-    # jax_platforms at interpreter start; the env var is too late —
-    # same pattern as bench.py / tests/conftest.py)
-    import jax
-    if os.environ.get("LGBTPU_RETRACE_DEVICE", "cpu") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    _install_listener()
-
-    measured = run_matrix()
-    print("measured trace counters:")
-    for k in sorted(measured):
-        print(f"  {k} = {measured[k]}")
-
-    if args.update:
-        write_budget(measured, args.budget)
-        print(f"pinned {len(measured)} counters to {args.budget}")
-        return 0
-
-    findings = check(measured, load_budget(args.budget))
+    findings = run_lint(args.budget, update=args.update)
     if findings:
         print("retrace lint: trace budget violations:", file=sys.stderr)
         for f in findings:

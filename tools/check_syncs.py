@@ -16,21 +16,25 @@ with work still queued), so even intentional fences must go through
 Comments and string literals are ignored (tokenize-based), so
 documentation may mention the calls freely.
 
-Run standalone (``python tools/check_syncs.py``; exit 1 on findings) or
-via tier-1 (tests/test_observability.py calls ``find_raw_syncs``).
+Run via the unified driver (``python tools/lint.py``; tier-1), or
+standalone (``python tools/check_syncs.py``; exit 1 on findings), or
+in-process (tests/test_observability.py calls ``find_raw_syncs``).
+The parsing/stale-entry plumbing lives in ``tools/analyze/lintlib.py``,
+shared with the retrace/race/purity lints.
 """
 
 from __future__ import annotations
 
-import io
 import os
 import re
 import sys
-import tokenize
-from typing import Dict, List, Set, Tuple
+from typing import List, Set, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "lightgbm_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from analyze import lintlib                              # noqa: E402
+
+REPO = lintlib.REPO
+PACKAGE = lintlib.PACKAGE
 ALLOWLIST = os.path.join(REPO, "tools", "sync_allowlist.txt")
 
 # the module that owns the fence primitive; everything inside may sync
@@ -40,44 +44,9 @@ _SYNC_RE = re.compile(
     r"device_get\s*\(|block_until_ready\b|\.item\s*\(\s*\)")
 
 
-def _code_lines(path: str) -> Dict[int, str]:
-    """line number -> source line, with comment and string tokens
-    blanked out so docs/docstrings never trigger the lint."""
-    with open(path, "rb") as f:
-        src = f.read()
-    text = src.decode("utf-8")
-    lines = text.splitlines()
-    drop: List[Tuple[int, int, int, int]] = []
-    try:
-        for tok in tokenize.tokenize(io.BytesIO(src).readline):
-            if tok.type in (tokenize.COMMENT, tokenize.STRING):
-                drop.append((*tok.start, *tok.end))
-    except tokenize.TokenError:
-        pass                     # partial file: lint what parsed
-    out = {i + 1: ln for i, ln in enumerate(lines)}
-    for (r0, c0, r1, c1) in drop:
-        for r in range(r0, r1 + 1):
-            ln = out.get(r, "")
-            a = c0 if r == r0 else 0
-            b = c1 if r == r1 else len(ln)
-            out[r] = ln[:a] + " " * (b - a) + ln[b:]
-    return out
-
-
 def load_allowlist(path: str = ALLOWLIST) -> Set[Tuple[str, str]]:
     """Entries are ``relative/path.py | exact stripped source line``."""
-    out: Set[Tuple[str, str]] = set()
-    try:
-        with open(path) as f:
-            for raw in f:
-                raw = raw.strip()
-                if not raw or raw.startswith("#"):
-                    continue
-                rel, _, line = raw.partition("|")
-                out.add((rel.strip(), line.strip()))
-    except OSError:
-        pass
-    return out
+    return {key for key, _ in lintlib.parse_pins(path, 2)}
 
 
 def find_raw_syncs(root: str = PACKAGE,
@@ -89,29 +58,22 @@ def find_raw_syncs(root: str = PACKAGE,
     allow = load_allowlist(allowlist_path)
     used: Set[Tuple[str, str]] = set()
     findings: List[str] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
+    for path in lintlib.iter_py(root):
+        rel = lintlib.rel_to_root(path, root)
+        if rel in EXEMPT:
+            continue
+        for lineno, code in sorted(lintlib.code_lines(path).items()):
+            if not _SYNC_RE.search(code):
                 continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, REPO)
-            if rel in EXEMPT:
+            # the allowlist pins the ORIGINAL stripped line text
+            with open(path) as f:
+                stripped = f.read().splitlines()[lineno - 1].strip()
+            key = (rel, stripped)
+            if key in allow:
+                used.add(key)
                 continue
-            for lineno, code in sorted(_code_lines(path).items()):
-                if not _SYNC_RE.search(code):
-                    continue
-                # the allowlist pins the ORIGINAL stripped line text
-                with open(path) as f:
-                    stripped = f.read().splitlines()[lineno - 1].strip()
-                key = (rel, stripped)
-                if key in allow:
-                    used.add(key)
-                    continue
-                findings.append(f"{rel}:{lineno}: {stripped}")
-    for key in sorted(allow - used):
-        findings.append(f"stale allowlist entry (no matching line): "
-                        f"{key[0]} | {key[1]}")
+            findings.append(f"{rel}:{lineno}: {stripped}")
+    findings.extend(lintlib.stale_pins(allow, used, "allowlist"))
     return findings
 
 

@@ -1,0 +1,143 @@
+"""Unified static-analysis driver: one entry point, one exit code.
+
+Runs the whole lint family (docs/Static-Analysis.md) over the tree:
+
+- **races**    — lock-discipline race lint for the threaded
+  serve/continual stack (tools/analyze/check_races.py;
+  tools/race_allowlist.txt)
+- **purity**   — jit-purity lint over every function reachable inside
+  a traced body (tools/analyze/check_purity.py;
+  tools/purity_allowlist.txt)
+- **syncs**    — raw host-sync lint (tools/check_syncs.py;
+  tools/sync_allowlist.txt)
+- **retraces** — retrace-budget lint; runs the canonical training/serve
+  matrix on CPU, so it costs ~15 s warm (tools/check_retraces.py;
+  tools/retrace_budget.txt, the one pass ``--update`` re-pins)
+
+Tier-1 invokes ``python tools/lint.py`` once (tests/test_zlint.py)
+instead of separate sync/retrace invocations.  Exit code 0 only when
+EVERY selected pass is clean; every pass shares the lintlib pin-file
+conventions — mandatory rationales on race/purity allowlists, stale
+entries are errors everywhere.
+
+Usage::
+
+    python tools/lint.py                     # all four passes
+    python tools/lint.py --only races,purity # static passes only
+    python tools/lint.py --only retraces --update   # re-pin budget
+    python tools/lint.py --package-root /tmp/copy/lightgbm_tpu \
+        --only races                         # tamper tests
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Callable, Dict, List, Tuple
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, TOOLS)
+from analyze import check_purity, check_races, lintlib   # noqa: E402
+
+PASSES = ("races", "purity", "syncs", "retraces")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", default=",".join(PASSES),
+                    help="comma-separated subset of: "
+                         + ", ".join(PASSES))
+    ap.add_argument("--package-root", default=lintlib.PACKAGE,
+                    help="package tree to lint (tamper tests point "
+                         "this at a modified copy)")
+    ap.add_argument("--race-allowlist", default=check_races.ALLOWLIST)
+    ap.add_argument("--purity-allowlist",
+                    default=check_purity.ALLOWLIST)
+    ap.add_argument("--sync-allowlist", default=None)
+    ap.add_argument("--budget", default=None,
+                    help="retrace budget file override")
+    ap.add_argument("--update", action="store_true",
+                    help="re-pin the retrace budget from this run "
+                         "(the only pass with measured pins; "
+                         "allowlists are hand-edited, rationale "
+                         "required)")
+    args = ap.parse_args(argv)
+
+    selected = [p.strip() for p in args.only.split(",") if p.strip()]
+    unknown = sorted(set(selected) - set(PASSES))
+    if unknown:
+        print(f"unknown pass(es): {', '.join(unknown)} "
+              f"(valid: {', '.join(PASSES)})", file=sys.stderr)
+        return 2
+
+    root = args.package_root
+    if "retraces" in selected and os.path.abspath(root) \
+            != os.path.abspath(lintlib.PACKAGE):
+        # the retrace pass imports and MEASURES the installed package;
+        # silently linting the real tree while the AST passes lint the
+        # copy would green-wash a planted retrace regression
+        print("--package-root does not apply to the retraces pass "
+              "(it measures the installed package); use "
+              "--only races,purity,syncs with a package copy",
+              file=sys.stderr)
+        return 2
+
+    def run_syncs() -> List[str]:
+        import check_syncs
+        return check_syncs.find_raw_syncs(
+            root, args.sync_allowlist or check_syncs.ALLOWLIST)
+
+    def run_retraces() -> List[str]:
+        import check_retraces
+        return check_retraces.run_lint(
+            args.budget or check_retraces.BUDGET, update=args.update)
+
+    runners: Dict[str, Tuple[Callable[[], List[str]], str]] = {
+        "races": (lambda: check_races.run(root, args.race_allowlist),
+                  "take the lock, declare the class lock contract, or "
+                  "pin in tools/race_allowlist.txt"),
+        "purity": (lambda: check_purity.run(root,
+                                            args.purity_allowlist),
+                   "move the effect out of the traced body, or pin in "
+                   "tools/purity_allowlist.txt"),
+        "syncs": (run_syncs,
+                  "route fences through obs.trace.fence, or pin in "
+                  "tools/sync_allowlist.txt"),
+        "retraces": (run_retraces,
+                     "if intentional, re-pin with `python tools/lint.py"
+                     " --only retraces --update`"),
+    }
+
+    # cheap AST passes first; the retrace pass (trains the canonical
+    # matrix) last so a red static pass fails fast
+    order = [p for p in PASSES if p in selected]
+    failed: List[str] = []
+    for name in order:
+        fn, hint = runners[name]
+        try:
+            findings = fn()
+        except Exception as e:      # noqa: BLE001 — a crashed pass is
+            # a finding, not a free pass; carry the traceback so the
+            # failing construct is locatable from the tier-1 log alone
+            import traceback
+            findings = [f"lint pass crashed: {type(e).__name__}: {e}",
+                        *traceback.format_exc().rstrip().splitlines()]
+        if findings:
+            failed.append(name)
+            print(f"[{name}] {len(findings)} finding(s):",
+                  file=sys.stderr)
+            for f in findings:
+                print(f"  {f}", file=sys.stderr)
+            print(f"[{name}] hint: {hint}", file=sys.stderr)
+        else:
+            print(f"[{name}] clean")
+    if failed:
+        print(f"\nlint: FAILED ({', '.join(failed)})", file=sys.stderr)
+        return 1
+    print(f"lint: all passes clean ({', '.join(order)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
