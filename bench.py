@@ -504,6 +504,38 @@ def child_extras() -> None:
     except Exception as e:
         _record_point("continual", error=f"{type(e).__name__}: {e}"[:200])
 
+    # quantized-training histogram sweep (ISSUE 13, ops/quantize.py):
+    # f32 vs int8/int16 packed accumulands through the SHIPPED
+    # contraction across split_batch slot widths K in {16,32,64}
+    # (tools/bench_hist.run_quant_bench), folded into extras as
+    # hist_quant_*.  The gated key is hist_hbm_bytes_per_iter: the
+    # static ledger's histogram HBM bytes for ONE canonical 255-leaf
+    # K=16 iteration under quant_bits=8 — lower-better, the ledger-
+    # proven cut this PR exists for (tools/perf_budget.txt pin)
+    try:
+        sys.path.insert(0, os.path.join(_DIR, "tools"))
+        import bench_hist
+        qp = bench_hist.run_quant_bench(
+            n_rows=50_000 if cpu else 500_000, reps=3 if cpu else 10)
+        _record_point("hist_quant", cpu=cpu, **qp)
+        from lightgbm_tpu.obs.flops import FlopLedger
+        steps = -(-254 // 16)        # canonical 255-leaf K=16 iteration
+        led_q8 = FlopLedger.for_training(
+            n, N_FEAT, PRIMARY_MAX_BIN, split_batch=16,
+            vals_itemsize=1, quant=True)
+        led_f32 = FlopLedger.for_training(
+            n, N_FEAT, PRIMARY_MAX_BIN, split_batch=16)
+        site_q8 = {s.site: s for s in led_q8.sites()}
+        site_f32 = {s.site: s for s in led_f32.sites()}
+        _record_point(
+            "hist", cpu=cpu,
+            hbm_bytes_per_iter=site_q8["hist"].hbm_bytes * steps
+            + site_q8["hist_root"].hbm_bytes,
+            hbm_bytes_per_iter_f32=site_f32["hist"].hbm_bytes * steps
+            + site_f32["hist_root"].hbm_bytes)
+    except Exception as e:
+        _record_point("hist_quant", error=f"{type(e).__name__}: {e}"[:200])
+
     # comm wire bytes per boosting iteration (obs/comm.py static model,
     # same math the telemetry counters use at train time): the in-flight
     # number arXiv:1706.08359 instruments to validate scaling — one

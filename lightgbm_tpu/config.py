@@ -202,6 +202,25 @@ _PARAMS: Dict[str, tuple] = {
     # round-trip (measured ~67 ms on a tunneled chip) over the chunk.
     # 0/1 disables fusion.
     "fused_chunk": (int, 25, []),
+    # quantized training (docs/Quantized-Training.md, ROADMAP item 3):
+    # pack per-row gradients/hessians to int8/int16 with one shared
+    # per-channel scale per iteration and stochastic rounding, and
+    # accumulate EXACT int32 histograms through the one-hot contraction
+    # — 2-4x less HBM traffic per histogram pass and a step toward the
+    # MXU's low-precision throughput.  Gains/leaf values are computed
+    # from dequantized totals at split-scan time only; an AUC/metric
+    # parity harness (tests/test_quant.py) pins quant-vs-f32 quality on
+    # regression/binary/multiclass/lambdarank.  false (default) is
+    # byte-identical to pre-quantization training
+    "quant_train": (bool, False, ["use_quantized_grad"]),
+    # packed gradient/hessian width: 8 (int8 lanes, the full HBM win)
+    # or 16 (int16, tighter parity at half the bandwidth saving)
+    "quant_bits": (int, 8, []),
+    # stochastic (unbiased, iteration-keyed counter RNG — resume stays
+    # byte-identical) | nearest (deterministic, biased).  No alias to
+    # the reference's bool `stochastic_rounding` on purpose: a bool
+    # value would coerce to a nonsense mode string
+    "quant_round": (str, "stochastic", []),
     # leaves split per grower super-step (masked learner).  1 = exact
     # strict leaf-wise growth (reference semantics).  K>1 splits the top-K
     # leaves by cached gain per step and builds all K child histograms in
@@ -643,6 +662,13 @@ class Config:
             raise ValueError("max_bin must be >= 2")
         if self.num_leaves < 2:
             raise ValueError("num_leaves must be >= 2")
+        if self.quant_bits not in (8, 16):
+            raise ValueError(f"quant_bits={self.quant_bits} must be 8 "
+                             "or 16")
+        if self.quant_round not in ("stochastic", "nearest"):
+            raise ValueError(
+                f"quant_round={self.quant_round!r} must be one of: "
+                "stochastic, nearest")
         if self.finite_check_policy not in ("raise", "skip_iter", "clamp"):
             raise ValueError(
                 f"finite_check_policy={self.finite_check_policy!r} must be "

@@ -185,6 +185,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 bynode_frac: float = 1.0, bynode_seed: int = 0,
                 cegb=None,
                 padded_leaves: Optional[int] = None,
+                quant=None,
+                scale_reduce: Optional[Callable] = None,
+                row_offset: Optional[Callable] = None,
                 jit: bool = True):
     """Build a jitted ``grow_tree(binned, vals, feature_mask, num_bin, na_bin,
     na_bin_part=None)``.
@@ -270,6 +273,20 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       parallel learners) because ranges derive from replicated split
       decisions.  mono_penalty applies the depth-based gain de-rating
       (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:355).
+    - quant: a ``QuantSpec`` (ops/quantize.py) — quantized training:
+      the (grad, hess, weight) stack is packed to int8/int16 with one
+      shared per-channel scale per call (= per boosting iteration) and
+      iteration-keyed stochastic rounding (``rng_iter`` keys the
+      counter-based stream, so resume stays byte-identical), histograms
+      accumulate exact int32 through the same one-hot contraction (the
+      carry, the subtraction trick and any ``hist_reduce`` collective
+      all run on int32), and dequantization happens only at split-scan
+      time (ops/split.py ``dequantize_hist``).  Hooks for the sharded
+      learners: ``scale_reduce`` maxes the [3] scale vector across the
+      mesh so every shard quantizes with the GLOBAL scale, and
+      ``row_offset(n_local)`` returns this shard's global row offset so
+      the rounding stream is keyed by GLOBAL row ids — together they
+      make the int32 reduce bitwise dp==serial.
     - split_batch=K>1: grow K leaves per super-step instead of strictly
       one.  Each step picks the top-K leaves by cached best gain, applies
       all K splits in one row-partition pass, and builds all K smaller
@@ -286,7 +303,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         else L_req
     padded = L != L_req
     B = int(num_bins)
-    reduce_fn = hist_reduce or (lambda h: h)
+    use_quant = quant is not None
+    if use_quant:
+        from .ops.quantize import quant_scales, quantize_stack
+        from .ops.split import dequantize_hist
+    reduce_fn = hist_reduce or (lambda h, scales=None: h)
     view_fn = hist_view or (lambda b: b)
     select_fn = select_best or (lambda r: r)
     use_subtraction = subtract
@@ -308,12 +329,15 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         def _expand(gh, total):
             return gh
 
-    def _hist(binned_view, vals, slot=None, nslots=1):
+    def _hist(binned_view, vals, slot=None, nslots=1, scales=None):
         """Reduced histogram; with ``slot`` a per-slot multi-histogram
         (split_batch) whose vals ⊗ onehot(slot) expansion happens inside
         the scan (ops/histogram.py), never as an [N, 3*K] HBM buffer.
         Sparse-binned data takes the O(nnz) segment-sum formulation
-        (sparse_data.py) instead of the one-hot contraction."""
+        (sparse_data.py) instead of the one-hot contraction.  Under
+        quantized training the hook receives the iteration's scales as
+        a second argument (voting's gain-statistic vote needs real
+        values; the reduce itself stays int32)."""
         if isinstance(binned_view, _spd.SparseBinned):
             h = _spd.histogram(binned_view, vals, num_bins=Bh, slot=slot,
                                num_slots=nslots)
@@ -321,9 +345,37 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             h = compute_histogram(binned_view, vals, num_bins=Bh,
                                   block_rows=block_rows, slot=slot,
                                   num_slots=nslots)
-        return reduce_fn(h)
+        return reduce_fn(h, scales) if use_quant else reduce_fn(h)
 
-    def _make_child_hist(n: int):
+    def _quant_prepare(n, vals, feature_mask, rng_iter, n_leaves):
+        """Shared quantized-training entry for the strict and batched
+        growers: trace-time flop/byte notes, the per-iteration GLOBAL
+        scales, and the iteration-keyed stochastic quantization of the
+        grad/hess/weight stack (ops/quantize.py).  One definition so
+        the rounding key and scale reduction can never diverge between
+        the two paths — the fused==per-iter and dp==serial bitwise
+        contracts hang off them.  Returns (vals, scales, scan_expand);
+        ``n_leaves`` sizes the dequant ledger note (2 children per
+        split, 2K under a K-way super-step)."""
+        from .obs.flops import (dequant_flops_bytes, note_traced,
+                                quantize_flops_bytes)
+        note_traced("quantize", *quantize_flops_bytes(
+            n, quant.itemsize), phase="grow", cadence="iter")
+        note_traced("dequant", *dequant_flops_bytes(
+            feature_mask.shape[0], B, n_leaves=n_leaves), phase="grow")
+        scales = quant_scales(vals, quant.qmax)
+        if scale_reduce is not None:
+            scales = scale_reduce(scales)
+        off = row_offset(n) if row_offset is not None else 0
+        ikey = jnp.int32(0) if rng_iter is None \
+            else jnp.asarray(rng_iter, jnp.int32)
+        vals = quantize_stack(vals, scales, quant, ikey, off)
+
+        def scan_expand(h, t):
+            return _expand(dequantize_hist(h, scales), t)
+        return vals, scales, scan_expand
+
+    def _make_child_hist(n: int, scales=None):
         """Child-histogram builder: tiered gather (see ``gather`` above)
         with a masked full-N pass as the top tier / fallback."""
         caps = []
@@ -338,7 +390,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
             def full_pass(_):
                 mask = in_child.astype(vals.dtype)[:, None]
-                return _hist(binned_view, vals * mask)
+                return _hist(binned_view, vals * mask, scales=scales)
 
             if not caps:
                 return full_pass(None)
@@ -358,7 +410,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                         b_g = jnp.take(binned_view, safe, axis=0)
                     v_g = jnp.take(vals, safe, axis=0) \
                         * (idx < n)[:, None].astype(vals.dtype)
-                    return _hist(b_g, v_g)
+                    return _hist(b_g, v_g, scales=scales)
                 return f
 
             return lax.switch(tier, [gather_tier(c) for c in caps]
@@ -494,14 +546,30 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         return l_lo, l_hi, r_lo, r_hi
 
     def _root_eval(binned_view, vals, feature_mask, num_bin, na_bin,
-                   is_cat, rng_iter, cuse0=None):
+                   is_cat, rng_iter, cuse0=None, expand=None,
+                   scales=None):
         """Root histogram + aggregates + best split; shared by the strict
-        and batched growers."""
-        hist0 = _hist(binned_view, vals)            # [F|G, B|Bg, 3]
+        and batched growers.  ``expand``/``scales``: quantized training
+        — ``vals`` is already the int stack, ``expand`` dequantizes
+        before the scan-space view, and the root aggregates come from
+        exact int32 sums dequantized by the shared scales."""
+        expand = _expand if expand is None else expand
+        hist0 = _hist(binned_view, vals, scales=scales)  # [F|G, B|Bg, 3]
         # root aggregates from vals directly, NOT from hist0[0]: a filtering
         # hist_reduce (voting's top-k zeroing) may have dropped feature 0's
         # histogram, and this is also one less reduction of a big tensor
-        if sum_reduce is not None:
+        if scales is not None:
+            # int32 sums are exact; cross-shard sum_reduce (psum) runs
+            # on the integers so the dequantized totals are bitwise
+            # identical between serial and every sharded learner
+            if sum_reduce is not None:
+                ti = sum_reduce(vals.astype(jnp.int32).sum(axis=0))
+            elif hist_reduce is not None:
+                ti = hist0[0].sum(axis=0)
+            else:
+                ti = vals.astype(jnp.int32).sum(axis=0)
+            total0 = dequantize_hist(ti, scales)
+        elif sum_reduce is not None:
             total0 = sum_reduce(vals.sum(axis=0))
         elif hist_reduce is not None:
             # caller-supplied reduce hook without a sum_reduce: derive the
@@ -545,7 +613,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 kw["gain_scale"] = _mono_gain_scale(jnp.int32(0))
         if use_cegb:
             kw["gain_penalty"] = _cegb_penalty(total0[2], cuse0)
-        res0 = select_fn(find_best_split(_expand(hist0, total0), total0,
+        res0 = select_fn(find_best_split(expand(hist0, total0), total0,
                                          num_bin, na_bin, fmask_root,
                                          params, root_out, is_cat, **kw))
         return hist0, total0, root_out, res0, et_key, bn_key
@@ -557,8 +625,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         neg_inf = jnp.float32(-jnp.inf)
         return _GrowState(
             leaf_of_row=jnp.zeros(n, jnp.int32),
+            # quantized training carries the histogram state as exact
+            # int32 (dtype follows the root pass); subtraction and the
+            # reduce collectives stay integer, dequantized only at scan
             hist=jnp.zeros((nleaf, fv, Bh, 3),
-                           jnp.float32).at[0].set(hist0),
+                           hist0.dtype).at[0].set(hist0),
             olo=jnp.full(nleaf, neg_inf),
             ohi=jnp.full(nleaf, jnp.inf),
             # branch sets start empty (root has no ancestors)
@@ -610,7 +681,12 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             limit = jnp.asarray(max_leaves, jnp.int32)
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
-        child_hist = _make_child_hist(n)
+        scales = None
+        scan_expand = _expand
+        if use_quant:
+            vals, scales, scan_expand = _quant_prepare(
+                n, vals, feature_mask, rng_iter, n_leaves=2)
+        child_hist = _make_child_hist(n, scales)
         if na_bin_part is None:
             na_bin_part = na_bin
         if num_bin_part is None:
@@ -622,7 +698,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
         hist0, total0, root_out, res0, et_key, bn_key = _root_eval(
             binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
-            rng_iter, cuse0)
+            rng_iter, cuse0, expand=scan_expand, scales=scales)
         # the carry follows the REDUCED histogram's feature axis, not the
         # binned view's: an owner-shard hist_reduce leaves each shard with
         # only its chunk of the global histograms ([L, F/n, B, 3])
@@ -757,9 +833,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     cuse = st.cuse | (
                         jnp.arange(st.cuse.shape[0], dtype=jnp.int32)
                         == feat)
-                r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
-                            na_bin, feature_mask, po2, is_cat, rand2,
-                            lo2, hi2, depth2, fmask2, cuse)
+                r2 = _best2(jax.vmap(scan_expand)(hist2, tot2), tot2,
+                            num_bin, na_bin, feature_mask, po2, is_cat,
+                            rand2, lo2, hi2, depth2, fmask2, cuse)
                 depth_ok = (max_depth <= 0) | (d < max_depth)
                 g2 = jnp.where(depth_ok, r2.gain, -jnp.inf)
 
@@ -855,6 +931,11 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             limit = jnp.asarray(max_leaves, jnp.int32)
         n, _f_global = binned.shape
         binned_view = view_fn(binned)
+        scales = None
+        scan_expand = _expand
+        if use_quant:
+            vals, scales, scan_expand = _quant_prepare(
+                n, vals, feature_mask, rng_iter, n_leaves=2 * K)
         if na_bin_part is None:
             na_bin_part = na_bin
         if num_bin_part is None:
@@ -867,7 +948,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
 
         hist0, total0, root_out, res0, et_key, bn_key = _root_eval(
             binned_view, vals, feature_mask, num_bin, na_bin, is_cat,
-            rng_iter, cuse0)
+            rng_iter, cuse0, expand=scan_expand, scales=scales)
         # carry feature axis = the REDUCED histogram's (owner-shard chunk
         # under the scatter-reducing dp learner; the view width otherwise)
         fh = hist0.shape[0]
@@ -949,8 +1030,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 tslot_of_leaf = jnp.full(LP, -1, jnp.int32) \
                     .at[targets].set(jnp.arange(nC, dtype=jnp.int32))
                 tslot = tslot_of_leaf[leaf_of_row]           # [N]
-                hist_c = _hist(binned_view, vals, tslot,
-                               nC)                           # [Fh, Bh, 3nC]
+                hist_c = _hist(binned_view, vals, tslot, nC,
+                               scales=scales)                # [Fh, Bh, 3nC]
                 hist_c = hist_c.reshape(fh, Bh, 3, nC) \
                     .transpose(3, 0, 1, 2)                   # [nC, Fh, Bh, 3]
                 if use_subtraction:
@@ -1031,9 +1112,9 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                     marks = jnp.zeros(st.cuse.shape[0], jnp.int32) \
                         .at[feat_k].add(valid.astype(jnp.int32))
                     cuse = st.cuse | (marks > 0)
-                r2 = _best2(jax.vmap(_expand)(hist2, tot2), tot2, num_bin,
-                            na_bin, feature_mask, po2, is_cat, rand2,
-                            lo2, hi2, depth2, fmask2, cuse)
+                r2 = _best2(jax.vmap(scan_expand)(hist2, tot2), tot2,
+                            num_bin, na_bin, feature_mask, po2, is_cat,
+                            rand2, lo2, hi2, depth2, fmask2, cuse)
                 d2 = jnp.concatenate([d_k, d_k])
                 depth_ok = (max_depth <= 0) | (d2 < max_depth)
                 valid2 = jnp.concatenate([valid, valid])
@@ -1138,7 +1219,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     key = None
     if all(h is None for h in (hist_reduce, hist_view, hist_expand,
                                select_best, mono_view, count_reduce,
-                               sum_reduce)):
+                               sum_reduce, scale_reduce, row_offset)):
         key = _grower_key(dict(
             L=L, B=B, K=K, padded=padded, params=params,
             max_depth=max_depth, block_rows=block_rows, subtract=subtract,
@@ -1146,7 +1227,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             gain_scale=gain_scale, extra_trees=extra_trees,
             extra_seed=extra_seed, mono=mono, mono_penalty=mono_penalty,
             interaction_groups=interaction_groups, bynode_frac=bynode_frac,
-            bynode_seed=bynode_seed, cegb=cegb,
+            bynode_seed=bynode_seed, cegb=cegb, quant=quant,
             # unpadded growers bake the budget as the default limit, so
             # the key must carry it; padded ones take it per call
             L_default=None if padded else L_req))

@@ -31,8 +31,18 @@ from jax import lax
 
 from .grower import TreeArrays
 from .ops.histogram import compute_histogram
-from .ops.split import (SplitParams, SplitResult, find_best_split,
-                        leaf_output, monotone_penalty_factor)
+from .ops.split import (SplitParams, SplitResult, dequantize_hist,
+                        find_best_split, leaf_output,
+                        monotone_penalty_factor)
+
+
+def _quantize_vals(vals, rng_iter, *, spec):
+    """Per-iteration quantization for the partitioned learner: shared
+    per-channel scales + iteration-keyed stochastic rounding
+    (ops/quantize.py; single-chip, so global row id == row index)."""
+    from .ops.quantize import quant_scales, quantize_stack
+    scales = quant_scales(vals, spec.qmax)
+    return quantize_stack(vals, scales, spec, rng_iter, 0), scales
 
 
 def _pow2(x: int) -> int:
@@ -181,7 +191,8 @@ class PartitionedGrower:
                  bynode_frac: float = 1.0, bynode_seed: int = 0,
                  efb=None, pool_entries: int = 0,
                  feature_contri: Optional[np.ndarray] = None,
-                 extra_trees: bool = False, extra_seed: int = 6):
+                 extra_trees: bool = False, extra_seed: int = 6,
+                 quant=None):
         self.L = int(num_leaves)
         self.B = int(num_bins)
         self.params = params
@@ -213,6 +224,15 @@ class PartitionedGrower:
             jnp.asarray(feature_contri, jnp.float32)
         self.extra_trees = bool(extra_trees)
         self._extra_rng = np.random.RandomState(extra_seed)
+        # quantized training (ops/quantize.py): vals are packed once per
+        # grow() call (= per iteration) on device, the per-segment
+        # histograms accumulate exact int32 (subtraction included), and
+        # _find_leaf dequantizes at scan time — the same contract as the
+        # masked grower, on the host-orchestrated loop
+        self.quant = quant
+        if quant is not None:
+            self._quantize = jax.jit(functools.partial(
+                _quantize_vals, spec=quant))
         self._find = jax.jit(functools.partial(find_best_split, params=params))
         # HistogramPool analog (feature_histogram.hpp:1095,
         # histogram_pool_size): cap the number of device-resident per-leaf
@@ -232,7 +252,8 @@ class PartitionedGrower:
 
     def grow(self, binned, vals, feature_mask, num_bin, na_bin,
              is_cat=None, forced=None,
-             cegb_state: Optional[CEGBState] = None) -> TreeArrays:
+             cegb_state: Optional[CEGBState] = None,
+             rng_iter=None) -> TreeArrays:
         L, B = self.L, self.B
         n = binned.shape[0]
         p_full = _pow2(n)
@@ -240,11 +261,21 @@ class PartitionedGrower:
         nb_host = np.asarray(num_bin)
         na_host = np.asarray(na_bin)
 
+        scales = None
+        if self.quant is not None:
+            # pack once per tree; every segment histogram below is then
+            # an exact int32 accumulation, dequantized only at scan time
+            vals, scales = self._quantize(
+                jnp.asarray(vals),
+                jnp.int32(0 if rng_iter is None else rng_iter))
+
         # root histogram + split (over EFB groups when bundled)
         hist0 = _hist_segment(order, binned, vals, jnp.int32(0), jnp.int32(n),
                               p=p_full, num_bins=self.BH,
                               block_rows=self.block_rows)
         total0_dev = hist0[0].sum(axis=0)
+        if scales is not None:
+            total0_dev = dequantize_hist(total0_dev, scales)
         root_out_dev = leaf_output(total0_dev[0], total0_dev[1], self.params)
         total0, root_out = jax.device_get((total0_dev, root_out_dev))
         total0 = np.asarray(total0)
@@ -289,6 +320,10 @@ class PartitionedGrower:
             return jnp.asarray(mask)
 
         def _find_leaf(hist, total, pout, leaf):
+            if scales is not None:
+                # quantized training: dequantize AT SCAN TIME only
+                # (ops/split.py dequantize_hist) — int32 everywhere else
+                hist = dequantize_hist(hist, scales)
             kw = {}
             if self.mono is not None:
                 kw = dict(mono=self.mono,
@@ -595,6 +630,8 @@ class PartitionedGrower:
             while queue and next_node < node_budget:
                 spec, leaf = queue.pop(0)
                 ph = _get_hist(leaf)
+                if scales is not None:
+                    ph = dequantize_hist(ph, scales)
                 fh = ph if self.efb is None else self._expand(
                     ph, jnp.asarray(totals[leaf], jnp.float32))
                 rec = self._forced_record(spec, fh, totals[leaf],

@@ -312,6 +312,24 @@ class GBDTModel:
                              "sample bundling); using the flat layout")
                 if not (sigs == sigs[0]).all() or int(sigs[0]) == 0:
                     self._use_efb = False
+        # quantized training (ROADMAP item 3, docs/Quantized-Training.md):
+        # one QuantSpec threads through every learner family below —
+        # masked (strict/batched/fused-chunk), partitioned, and all
+        # three distributed growers
+        self._quant = None
+        if config.quant_train:
+            if self._sparse:
+                raise ValueError(
+                    "quant_train requires dense binned storage (the "
+                    "sparse k-hot segment-sum histogram has no integer "
+                    "formulation yet); construct the Dataset with "
+                    "enable_sparse=false")
+            from ..ops.quantize import QuantSpec
+            self._quant = QuantSpec(
+                bits=int(config.quant_bits),
+                stochastic=(config.quant_round == "stochastic"),
+                seed=int(config.seed))
+
         if self._sparse:
             feat_binned = ds.binned_sparse.flat
         elif self._use_efb:
@@ -437,13 +455,12 @@ class GBDTModel:
                 f"trace family to K in {SPLIT_BATCH_SET}; set "
                 "trace_buckets=false to keep an off-set width)")
             self._split_batch = snapped
-        # leaf-budget bucketing: only the one-program (masked) growers
-        # take a traced budget — serial and tree_learner=data; the
-        # host-orchestrated partitioned learner and the voting/feature
-        # growers keep their exact shapes
+        # leaf-budget bucketing: every one-program (masked) grower takes
+        # a traced budget — serial, data, and (since the ROADMAP item-1
+        # remainder closed) the voting/feature growers too; only the
+        # host-orchestrated partitioned learner keeps exact shapes
         self._leaf_pad = None
-        if self._trace_buckets and learner == "masked" \
-                and dist in (None, "data"):
+        if self._trace_buckets and learner == "masked":
             lp = bucket_leaves(config.num_leaves)
             # inflation cap: the grower carries a [L, F, B, 3] histogram
             # per leaf slot, so padding a tiny budget to the 64 floor
@@ -452,6 +469,30 @@ class GBDTModel:
             # The common sweep (31/40/63 -> 64) stays well inside.
             if config.num_leaves < lp <= 4 * config.num_leaves:
                 self._leaf_pad = lp
+
+        if self._quant is not None:
+            # int32 accumulator headroom: every row contributes at most
+            # qmax per channel to its bin, and a degenerate (constant or
+            # NA-heavy) feature can put EVERY row in one bin — past
+            # rows * qmax > 2^31-1 the histogram (and the dp psum over
+            # shards, which sums to the same global totals) wraps
+            # silently.  Same quant_bits + log2(rows) arithmetic that
+            # rejected the 16-bit wire format
+            # (docs/Quantized-Training.md).
+            n_global = (int(self._global_counts.sum())
+                        if self._global_counts is not None
+                        else self.num_data)
+            if n_global * self._quant.qmax > 2 ** 31 - 1:
+                cap = (2 ** 31 - 1) // self._quant.qmax
+                hint = "quant_bits=8 (bound ~16.9M rows) or " \
+                    if self._quant.bits == 16 else ""
+                raise ValueError(
+                    f"quant_bits={self._quant.bits} can overflow the "
+                    f"int32 histogram accumulator at {n_global} rows: "
+                    f"a single bin may collect every row, so rows * "
+                    f"qmax ({self._quant.qmax}) must stay under 2^31 "
+                    f"(at most {cap} rows).  Use {hint}quant_train="
+                    "false.")
 
         if dist == "data":
             from ..parallel.data_parallel import make_dp_grower
@@ -465,6 +506,7 @@ class GBDTModel:
                 mono_penalty=config.monotone_penalty,
                 sparse=self._sparse,
                 padded_leaves=self._leaf_pad,
+                quant=self._quant,
                 # owner-shard reduce-scatter (dp_owner_shard=false falls
                 # back to the full-psum reduction for A/B comparison)
                 owner_shard=config.dp_owner_shard)
@@ -474,7 +516,8 @@ class GBDTModel:
                 self._mesh, num_leaves=config.num_leaves,
                 num_bins=self.max_bin, params=self.split_params,
                 top_k=config.top_k, max_depth=config.max_depth,
-                block_rows=config.rows_per_block)
+                block_rows=config.rows_per_block,
+                padded_leaves=self._leaf_pad, quant=self._quant)
         elif dist == "feature":
             from ..parallel.feature_parallel import make_fp_grower
             self.grower = make_fp_grower(
@@ -482,7 +525,8 @@ class GBDTModel:
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
                 block_rows=config.rows_per_block,
-                split_batch=self._split_batch)
+                split_batch=self._split_batch,
+                padded_leaves=self._leaf_pad, quant=self._quant)
         elif hist_reduce is None and learner == "partitioned":
             # single-chip performance learner (grower_partitioned.py):
             # histogram work ∝ smaller child, like the reference
@@ -500,7 +544,8 @@ class GBDTModel:
                 pool_entries=self._pool_entries(config, ds),
                 feature_contri=contri,
                 extra_trees=self._extra_trees,
-                extra_seed=config.extra_seed)
+                extra_seed=config.extra_seed,
+                quant=self._quant)
         else:
             if has_node_controls:
                 raise ValueError(
@@ -509,10 +554,17 @@ class GBDTModel:
                     "(tpu_learner=partitioned, single-chip); monotone "
                     "basic, interaction constraints, CEGB and "
                     "feature_fraction_bynode work on the masked learner")
+            # a caller-supplied hist_reduce hook keeps its single-arg
+            # contract; quantized growers call reduce hooks with the
+            # iteration's scales as a second argument (grower.py _hist)
+            if hist_reduce is not None and self._quant is not None:
+                user_reduce = hist_reduce
+                hist_reduce = lambda h, scales=None: user_reduce(h)  # noqa: E731
             self.grower = make_grower(
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
                 block_rows=config.rows_per_block, hist_reduce=hist_reduce,
+                quant=self._quant,
                 efb=self.efb_dev if self._use_efb else None,
                 gain_scale=contri, extra_trees=self._extra_trees,
                 extra_seed=config.extra_seed,
@@ -590,7 +642,14 @@ class GBDTModel:
                                if self.efb_dev is not None
                                else self.max_bin),
                     binned_itemsize=itemsize,
-                    num_class=self.num_class)
+                    num_class=self.num_class,
+                    # per-dtype HBM accounting: the quantized passes
+                    # read int8/int16 accumulands, and the quantize/
+                    # dequant sites join the perf.* roofline so
+                    # perf.hist.* shows the memory bound moving
+                    vals_itemsize=(self._quant.itemsize
+                                   if self._quant is not None else 4),
+                    quant=self._quant is not None)
                 self._obs.attach_flop_sites(self._flops)
         # flight recorder (obs/blackbox.py): None unless
         # telemetry_blackbox=true — zero ring allocation, no file
@@ -1144,6 +1203,7 @@ class GBDTModel:
                 bynode_seed=cfg.feature_fraction_seed + 1,
                 cegb=self._cegb_state,
                 padded_leaves=self._leaf_pad,
+                quant=self._quant,
                 jit=False)
             obj = self.objective
             lr = jnp.float32(self.learning_rate)
@@ -1176,7 +1236,11 @@ class GBDTModel:
                     w = jnp.ones_like(g)
                 vals = jnp.stack([g * w, h * w, w], axis=1)
                 kw = {"is_cat": ic} if ic is not None else {}
-                if self._extra_trees or self._bynode_masked:
+                if self._extra_trees or self._bynode_masked \
+                        or self._quant is not None:
+                    # quant: the scan's iteration index keys the
+                    # stochastic-rounding stream, so fused and per-iter
+                    # paths quantize identically
                     kw["rng_iter"] = it
                 if use_cegb:
                     kw["cegb_used"] = cuse
@@ -1504,6 +1568,11 @@ class GBDTModel:
             if self._ic_grow is not None:
                 gkw["is_cat"] = self._ic_grow
             from ..grower_partitioned import PartitionedGrower
+            if self._quant is not None:
+                # every learner family keys the quantizer's stochastic-
+                # rounding stream by the global iteration index, so
+                # resume replays the exact rounding of a straight run
+                gkw["rng_iter"] = jnp.int32(it_global)
             if isinstance(self.grower, PartitionedGrower):
                 if self._forced_spec is not None:
                     gkw["forced"] = self._forced_spec

@@ -56,11 +56,12 @@ def _nbytes(x: Any) -> int:
 
 
 def wire_bytes(collective: str, payload: int, n: int) -> int:
-    """Per-chip wire bytes under the ring model (module docstring)."""
+    """Per-chip wire bytes under the ring model (module docstring).
+    ``pmax`` follows the all-reduce cost (same ring, max combiner)."""
     if n <= 1:
         return 0
     frac = (n - 1) / n
-    if collective == "psum":
+    if collective in ("psum", "pmax"):
         return int(2 * frac * payload)
     # psum_scatter: payload = input bytes; all_gather: payload = OUTPUT
     # bytes (n * input) — callers pass the right one
@@ -90,6 +91,11 @@ class CommLedger:
              cadence: str = "step"):
         self._record(site, "psum", _nbytes(x), cadence)
         return lax.psum(x, axis_name)
+
+    def pmax(self, x, axis_name: str, *, site: str,
+             cadence: str = "step"):
+        self._record(site, "pmax", _nbytes(x), cadence)
+        return lax.pmax(x, axis_name)
 
     def psum_scatter(self, x, axis_name: str, *, site: str,
                      cadence: str = "step", **kw):
@@ -125,12 +131,19 @@ class CommLedger:
 
 
 def dp_hist_bytes_per_iter(n_shards: int, chunk: int, padded_bins: int,
-                           n_steps: int, split_batch: int = 1) -> int:
+                           n_steps: int, split_batch: int = 1,
+                           itemsize: int = 4) -> int:
     """Closed-form wire-byte estimate for the data-parallel owner-shard
     histogram reduce-scatter over one iteration — the PR 1 per-shard
     hist-bytes math (``OwnerShardPlan.hist_bytes``) times the reduce
     cadence, usable without building a mesh (bench.py extras).  The
     scattered tensor per step is ``[n_shards * chunk * split_batch,
-    padded_bins, 3]`` f32 (one chunk stack per batched leaf)."""
-    payload = n_shards * chunk * split_batch * padded_bins * 3 * 4
+    padded_bins, 3]`` at ``itemsize``-byte lanes: f32 for the default
+    path, int32 for quantized training (quant_train) — 4 bytes either
+    way, HALF the reference's f64 ``ReduceScatter`` wire format (its
+    hist_t is double; see docs/Quantized-Training.md for why a 16-bit
+    wire format is unsafe: local per-bin sums need 8 + log2(rows)
+    bits, so int16 lanes would wrap on any real shard)."""
+    payload = (n_shards * chunk * split_batch * padded_bins * 3
+               * int(itemsize))
     return wire_bytes("psum_scatter", payload, n_shards) * n_steps

@@ -70,19 +70,24 @@ def padded_bins(num_bins: int) -> int:
 
 def hist_flops_bytes(n_rows: int, n_cols: int, num_bins: int,
                      channels: int = 3,
-                     binned_itemsize: int = 1) -> Tuple[int, int]:
+                     binned_itemsize: int = 1,
+                     vals_itemsize: int = 4) -> Tuple[int, int]:
     """One full-N one-hot-contraction histogram pass over ``n_cols``
     binned columns (features, or EFB groups): ``hist[c, f*Bp] +=
     vals[c, n] @ onehot[n, f*Bp]`` — 2 FLOPs per MAC.  ``channels`` is
     the accumulated channel count (3 strict; 3K for the split_batch
-    multi-leaf contraction).  Bytes: binned matrix read + raw
-    (grad, hess, weight) vals read (+ the [N] slot vector when the
-    per-slot expansion is active) + histogram write; the one-hot is
-    generated in-registers (measured fused, ops/histogram.py)."""
+    multi-leaf contraction).  Bytes: binned matrix read + the
+    (grad, hess, weight) vals read AT THEIR STORED WIDTH
+    (``vals_itemsize``: 4 for f32, 1/2 for the int8/int16 quantized
+    packing — the per-dtype accounting the quant_train acceptance
+    instrument reads) + the [N] slot vector when the per-slot expansion
+    is active + histogram write (f32 and int32 are both 4-byte lanes);
+    the one-hot is generated in-registers (measured fused,
+    ops/histogram.py)."""
     bp = padded_bins(num_bins)
     flops = 2 * int(channels) * int(n_rows) * int(n_cols) * bp
     hbm = (int(n_rows) * int(n_cols) * int(binned_itemsize)
-           + int(n_rows) * 3 * 4
+           + int(n_rows) * 3 * int(vals_itemsize)
            + (int(n_rows) * 4 if channels > 3 else 0)
            + int(channels) * int(n_cols) * bp * 4)
     return flops, hbm
@@ -122,6 +127,30 @@ def partition_flops_bytes(n_rows: int,
     n = int(n_rows)
     return (PARTITION_OPS_PER_ROW * n,
             n * int(binned_itemsize) + 2 * n * 4)
+
+
+# ops per quantized value: divide by scale, hash-uniform draw (~2 mixes
+# amortized), add, floor, clip — a documented estimate (ops/quantize.py)
+QUANTIZE_OPS_PER_VAL = 5
+
+
+def quantize_flops_bytes(n_rows: int,
+                         out_itemsize: int = 1) -> Tuple[int, int]:
+    """One per-iteration grad/hess/weight packing pass (quant_train,
+    ops/quantize.py): the [N, 3] f32 stack read + the int8/int16 stack
+    written; the scale reduction's [N, 3] read fuses with it."""
+    n3 = 3 * int(n_rows)
+    return (QUANTIZE_OPS_PER_VAL * n3,
+            n3 * 4 + n3 * int(out_itemsize))
+
+
+def dequant_flops_bytes(n_cols: int, num_bins: int,
+                        n_leaves: int = 1) -> Tuple[int, int]:
+    """Split-scan-time dequantization (ops/split.py dequantize_hist):
+    one int32->f32 widening multiply per (leaf, column, bin, channel)
+    cell; int32 read + f32 write, both 4-byte lanes."""
+    cells = 3 * int(n_cols) * int(num_bins) * int(n_leaves)
+    return cells, 2 * 4 * cells
 
 
 def score_update_flops_bytes(n_rows: int) -> Tuple[int, int]:
@@ -273,7 +302,9 @@ class FlopLedger:
     def for_training(cls, n_rows: int, n_feat: int, num_bins: int,
                      split_batch: int = 1, hist_cols: int = None,
                      hist_bins: int = None, binned_itemsize: int = 1,
-                     num_class: int = 1) -> "FlopLedger":
+                     num_class: int = 1,
+                     vals_itemsize: int = 4,
+                     quant: bool = False) -> "FlopLedger":
         """The training-loop site table for the masked grower family.
 
         ``hist_cols``/``hist_bins``: the histogram pass's column/bin
@@ -283,7 +314,11 @@ class FlopLedger:
         ``num_class``: trees grown per iteration — iter-cadence sites
         run once PER CLASS, so their per-iteration values carry the
         factor (step-cadence sites get it through the summed
-        across-class step count the driver records).  Sites:
+        across-class step count the driver records).
+        ``vals_itemsize``/``quant``: quantized training (quant_train)
+        — the histogram passes read int8/int16 accumulands instead of
+        f32, and the quantize/dequant sites appear so ``perf.hist.*``
+        intensity/bound keys show the bound actually moving.  Sites:
 
         - ``hist``       smaller-child contraction, C=3K, per step
         - ``hist_root``  root contraction, C=3, per class per iter
@@ -291,6 +326,8 @@ class FlopLedger:
         - ``split_root`` root scan, per class per iteration
         - ``partition``  one row pass per step
         - ``score``      leaf-gather score update, per class per iter
+        - ``quantize``   grad/hess int packing, per class per iter
+        - ``dequant``    scan-time int32->f32 widen, per step
         """
         k = max(1, int(split_batch))
         nc = max(1, int(num_class))
@@ -298,10 +335,12 @@ class FlopLedger:
         hb = int(hist_bins) if hist_bins else int(num_bins)
         led = cls()
         f, b = hist_flops_bytes(n_rows, hc, hb, channels=3 * k,
-                                binned_itemsize=binned_itemsize)
+                                binned_itemsize=binned_itemsize,
+                                vals_itemsize=vals_itemsize)
         led.add("hist", "grow", f, b, "step")
         f, b = hist_flops_bytes(n_rows, hc, hb, channels=3,
-                                binned_itemsize=binned_itemsize)
+                                binned_itemsize=binned_itemsize,
+                                vals_itemsize=vals_itemsize)
         led.add("hist_root", "grow", f * nc, b * nc, "iter")
         f, b = split_scan_flops_bytes(n_feat, num_bins, n_leaves=2 * k)
         led.add("split_scan", "grow", f, b, "step")
@@ -311,4 +350,9 @@ class FlopLedger:
         led.add("partition", "grow", f, b, "step")
         f, b = score_update_flops_bytes(n_rows)
         led.add("score", "score", f * nc, b * nc, "iter")
+        if quant:
+            f, b = quantize_flops_bytes(n_rows, vals_itemsize)
+            led.add("quantize", "grow", f * nc, b * nc, "iter")
+            f, b = dequant_flops_bytes(n_feat, num_bins, n_leaves=2 * k)
+            led.add("dequant", "grow", f, b, "step")
         return led

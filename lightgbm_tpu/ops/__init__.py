@@ -1,2 +1,3 @@
 from .histogram import compute_histogram, hist_block_rows, HIST_BLOCK_ROWS
-from .split import find_best_split, SplitParams
+from .quantize import QuantSpec
+from .split import dequantize_hist, find_best_split, SplitParams

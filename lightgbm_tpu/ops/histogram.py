@@ -35,14 +35,21 @@ from jax import lax
 # faster than VMEM-sized 888-row blocks — XLA tiles the one-hot
 # internally, so second-guessing VMEM only shrank the matmuls.
 HIST_BLOCK_ROWS = 8192
-# ...but the one-hot intermediate is block*F*Bp*4 bytes: keep it bounded
-# so wide/high-bin datasets (e.g. Bosch-like 968 features x 256 bins)
-# don't materialize multi-GB scan blocks in HBM.
+# ...but the one-hot intermediate is block*F*Bp*itemsize bytes: keep it
+# bounded so wide/high-bin datasets (e.g. Bosch-like 968 features x 256
+# bins) don't materialize multi-GB scan blocks in HBM.
 HIST_ONEHOT_BUDGET = 64 * 1024 * 1024
 
 
-def hist_block_rows(num_features: int, padded_bins: int) -> int:
-    blk = HIST_ONEHOT_BUDGET // max(num_features * padded_bins * 4, 1)
+def hist_block_rows(num_features: int, padded_bins: int,
+                    itemsize: int = 4) -> int:
+    """Row-block size bounded by the one-hot intermediate's byte
+    budget.  ``itemsize`` is the accumuland (vals) element width — the
+    one-hot operand is generated at the SAME width so the dot's operand
+    dtypes match, so int8-packed passes (quant_train, ops/quantize.py)
+    get proportionally larger blocks than the f32 default."""
+    blk = HIST_ONEHOT_BUDGET \
+        // max(num_features * padded_bins * int(itemsize), 1)
     return max(8, min(HIST_BLOCK_ROWS, blk // 8 * 8))
 
 
@@ -66,8 +73,13 @@ def compute_histogram(binned: jax.Array, vals: jax.Array, *, num_bins: int,
     binned: [N, F] integer bins (uint8/uint16/int32)
     vals:   [N, C] float32 per-row accumulands (grad, hess, count-weight);
             rows outside the target leaf / bag must already be zeroed.
-    returns [F, num_bins, C] float32 — with ``slot`` set, C becomes
-    ``C * num_slots``.
+            int8/int16 vals (quantized training, ops/quantize.py) take
+            the integer contraction: the one-hot operand is generated at
+            the vals dtype and the dot accumulates **exact int32**, so
+            the returned histogram is int32 and cross-shard reductions
+            of it are bitwise order-independent.
+    returns [F, num_bins, C] float32 (int32 for integer vals) — with
+    ``slot`` set, C becomes ``C * num_slots``.
 
     slot/num_slots: per-row slot id in [0, num_slots) or negative for
     "no slot" (row contributes nothing).  The per-slot one-hot expansion
@@ -97,6 +109,11 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
                               num_slots: int = 1) -> jax.Array:
     n, f = binned.shape
     c = vals.shape[1] * (num_slots if slot is not None else 1)
+    # integer accumulands (quantized training): int8/int16 operands,
+    # exact int32 accumulation on the MXU's low-precision path
+    integer = jnp.issubdtype(vals.dtype, jnp.integer)
+    op_dt = vals.dtype if integer else jnp.float32
+    acc_dt = jnp.int32 if integer else jnp.float32
 
     # static FLOP/byte accounting from the TRACED shapes (obs/flops.py;
     # a Python side effect, so it fires once per fresh trace and costs
@@ -104,7 +121,8 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
     from ..obs.flops import hist_flops_bytes, note_traced
     note_traced("hist", *hist_flops_bytes(
         n, f, num_bins, channels=c,
-        binned_itemsize=getattr(binned.dtype, "itemsize", 1)),
+        binned_itemsize=getattr(binned.dtype, "itemsize", 1),
+        vals_itemsize=getattr(vals.dtype, "itemsize", 4)),
         phase="grow")
 
     # Pad the bin axis to a multiple of 64 so the [blk, F, Bp] -> [blk, F*Bp]
@@ -116,7 +134,8 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
     # and are sliced off at the end.
     bp = max(64, -(-num_bins // 64) * 64)
     if block_rows <= 0:
-        block_rows = hist_block_rows(f, bp)
+        block_rows = hist_block_rows(f, bp,
+                                     getattr(vals.dtype, "itemsize", 4))
     block_rows = min(block_rows, max(8, n))
 
     cv = vals.shape[1]                       # raw (unexpanded) channels
@@ -140,22 +159,24 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
         bins_blk, vals_blk = chunk[0], chunk[1]
         if slot is not None:
             # expand vals ⊗ onehot(slot) per block, fused into the scan:
-            # the [N, cv*K] operand never exists in HBM
-            oh_s = (chunk[2][:, None] == kiota).astype(jnp.float32)
+            # the [N, cv*K] operand never exists in HBM.  The 0/1 slot
+            # one-hot multiplies at the vals dtype (an int8 product of
+            # an int8 value and {0, 1} cannot overflow)
+            oh_s = (chunk[2][:, None] == kiota).astype(op_dt)
             vals_blk = (vals_blk[:, :, None] * oh_s[:, None, :]) \
                 .reshape(block_rows, c)
         onehot = (bins_blk.astype(jnp.int32)[:, :, None] == iota) \
-            .astype(jnp.float32).reshape(block_rows, f * bp)
+            .astype(op_dt).reshape(block_rows, f * bp)
         # [C, block] x [block, F*Bp] -> [C, F*Bp]: the narrow C=3 axis maps
         # to output SUBLANES (padded 3->8) instead of lanes (3->128), a
         # measured ~2.2x win over the transposed orientation
         h = lax.dot_general(
             vals_blk, onehot,
             dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=acc_dt)
         return acc + h, None
 
-    acc0 = jnp.zeros((c, f * bp), dtype=jnp.float32)
+    acc0 = jnp.zeros((c, f * bp), dtype=acc_dt)
     acc, _ = lax.scan(body, acc0, xs)
     return acc.reshape(c, f, bp).transpose(1, 2, 0)[:, :num_bins, :]
 

@@ -1,0 +1,116 @@
+"""Low-precision grad/hess packing for quantized histogram training.
+
+ROADMAP item 3 / docs/Quantized-Training.md: the histogram contraction
+(ops/histogram.py) is memory-bound — it drags f32 (grad, hess, weight)
+through HBM on every pass (the roofline ledger, obs/flops.py, proves
+where).  The fix bit-serial GBDT accelerators exploit ("Booster: An
+Accelerator for Gradient Boosting Decision Trees", arXiv:2011.02022)
+and upstream LightGBM later shipped as quantized training: pack the
+per-row accumulands to int8/int16 with ONE shared scale per channel per
+boosting iteration, accumulate **exact int32** histograms, and
+dequantize only when the split scan needs real-valued gains
+(ops/split.py ``dequantize_hist``).
+
+Scheme
+------
+- scale: per-channel ``max|v| / qmax`` over ALL rows of the iteration
+  (a traced scalar — no host read; distributed learners ``pmax`` the
+  [3] vector so every shard quantizes identically).
+- rounding: **stochastic** by default — ``floor(v/s + u)`` with
+  ``u ~ U[0,1)`` drawn from a counter-based hash of (GLOBAL row id,
+  channel, iteration, seed).  Keying by the global row id (not the
+  shard-local position) makes ``tree_learner=data`` quantize each row
+  exactly as serial does, and keying by the iteration makes
+  crash+resume replay the SAME rounding stream as a straight run
+  (snapshot resume fast-forwards the iteration offset, models/gbdt.py
+  ``set_resume_state``).  ``quant_round=nearest`` is the deterministic
+  biased alternative.
+- accumulation: the one-hot contraction runs on integer operands with
+  ``preferred_element_type=int32`` — int32 addition is exact and
+  order-independent, so the quant path's dp==serial histogram identity
+  is BITWISE (stronger than the f32 path, where reduction order is
+  only fixed per compiled program).
+
+Zero rows stay zero under both roundings (``floor(0 + u) = 0`` for
+``u < 1``), so out-of-bag / padded rows never leak into histograms.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class QuantSpec(NamedTuple):
+    """Static quantized-training configuration (hashable: part of the
+    grower's process-level memo key, grower.py ``_grower_key``)."""
+    bits: int = 8            # 8 -> int8 lanes, 16 -> int16
+    stochastic: bool = True  # stochastic (unbiased) vs nearest rounding
+    seed: int = 0            # folded into the per-iteration rounding key
+
+    @property
+    def qmax(self) -> int:
+        return (1 << (self.bits - 1)) - 1
+
+    @property
+    def dtype(self):
+        return jnp.int8 if self.bits == 8 else jnp.int16
+
+    @property
+    def itemsize(self) -> int:
+        return 1 if self.bits == 8 else 2
+
+
+def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer on uint32 lanes — the counter-based RNG core.
+    jax.random.fold_in per row would be orders of magnitude slower and
+    could not be sliced by global row id across shards."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def counter_uniform(row_id: jnp.ndarray, n_chan: int, iter_key,
+                    seed: int) -> jnp.ndarray:
+    """[N, n_chan] U[0,1) keyed by (global row id, channel, iteration,
+    seed) — identical values for a row regardless of which shard holds
+    it.  Top 24 bits only, so the f32 conversion is exact and the
+    result is strictly < 1 (floor(x + u) can never over-round)."""
+    k = _fmix32(jnp.asarray(iter_key).astype(jnp.uint32)
+                ^ jnp.uint32((int(seed) * 2654435761) & 0xFFFFFFFF))
+    chan = jnp.arange(n_chan, dtype=jnp.uint32)
+    h = _fmix32(row_id.astype(jnp.uint32)[:, None]
+                * jnp.uint32(0x9E3779B9)
+                ^ (chan[None, :] * jnp.uint32(0x85EBCA6B)) ^ k)
+    return (h >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
+
+def quant_scales(vals: jnp.ndarray, qmax: int,
+                 floor: float = 1e-30) -> jnp.ndarray:
+    """Per-channel shared scale [C] f32: ``max|v| / qmax`` (floored so
+    an all-zero channel dequantizes to exact zeros instead of NaN).
+    Distributed learners must ``pmax`` this vector across shards before
+    quantizing (grower.py ``scale_reduce`` hook) so the shared scale is
+    GLOBAL — the dp==serial identity depends on it."""
+    m = jnp.max(jnp.abs(vals), axis=0)
+    return jnp.maximum(m, jnp.float32(floor)) / jnp.float32(int(qmax))
+
+
+def quantize_stack(vals: jnp.ndarray, scales: jnp.ndarray,
+                   spec: QuantSpec, iter_key,
+                   row_offset) -> jnp.ndarray:
+    """[N, C] f32 -> [N, C] int8/int16 with the iteration's shared
+    scales.  ``row_offset`` is this shard's global row offset (0 for
+    serial / replicated-row learners)."""
+    x = vals / scales[None, :]
+    if spec.stochastic:
+        rows = jnp.asarray(row_offset, jnp.int32) \
+            + jnp.arange(vals.shape[0], dtype=jnp.int32)
+        u = counter_uniform(rows, vals.shape[1], iter_key, spec.seed)
+        q = jnp.floor(x + u)
+    else:
+        q = jnp.round(x)
+    qmax = spec.qmax
+    return jnp.clip(q, -qmax, qmax).astype(spec.dtype)

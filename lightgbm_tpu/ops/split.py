@@ -26,6 +26,32 @@ kEpsilon = 1e-15
 kMinScore = -jnp.inf
 
 
+def dequantize_hist(hist: jax.Array, scales: jax.Array) -> jax.Array:
+    """Quantized-training dequantization AT SPLIT-SCAN TIME: an exact
+    int32 histogram (or [3] leaf-total vector) whose trailing axis is
+    the (grad, hess, count) channel block becomes the real-valued f32
+    tensor the gain/leaf-value math below consumes.
+
+    The int32 accumulation (ops/histogram.py integer path) is exact, so
+    this one widening multiply is the ONLY place quantization noise
+    enters the split scan — totals and every cumsum derived from them
+    are deterministic integers times the iteration's shared scale, and
+    split selection is bit-reproducible across serial and every
+    sharded learner (the f32 path only guarantees that per compiled
+    program).  ``scales`` [3] broadcasts over a 3-channel trailing axis
+    and tiles over the split_batch 3K channel blocks.
+
+    A trace-time flop/byte note (obs/flops.py "dequant") is recorded by
+    the grower at its call sites, not here — this helper also runs on
+    tiny [3] totals where a per-call note would misattribute shapes.
+    """
+    c = hist.shape[-1]
+    s = scales
+    if c != s.shape[-1]:            # split_batch: 3K channels tile [3]
+        s = jnp.tile(s, c // s.shape[-1])
+    return hist.astype(jnp.float32) * s
+
+
 class SplitParams(NamedTuple):
     """Static split hyperparameters (hashable; closed over at jit time)."""
     lambda_l1: float = 0.0

@@ -93,10 +93,14 @@ def owner_hist_reduce(axis: str, n_shards: int, chunk: int,
     (data_parallel_tree_learner.cpp:185's communication shape; XLA
     lowers this to a true reduce-scatter over ICI, moving 1/n_shards of
     the bytes a full psum replicates to every chip).  ``ledger`` records
-    the payload statically at trace time (obs/comm.py)."""
+    the payload statically at trace time (obs/comm.py) — dtype-aware,
+    so quantized training's int32 payload (exact integer reduce, half
+    the reference's f64 ReduceScatter wire format) is accounted at its
+    real width.  ``scales`` is the quant hook contract (grower.py
+    ``_hist``); the reduce itself never needs it."""
     total = n_shards * chunk
 
-    def hist_reduce(h):
+    def hist_reduce(h, scales=None):
         h = pad_feature_axis(h, total)
         if ledger is not None:
             return ledger.psum_scatter(h, axis, site="dp.hist_reduce",
@@ -112,7 +116,7 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    split_batch: int = 1, mono=None,
                    mono_penalty: float = 0.0, sparse: bool = False,
                    owner_shard: bool = True,
-                   padded_leaves=None):
+                   padded_leaves=None, quant=None):
     """Jitted data-parallel ``grow_tree`` over ``mesh``.
 
     Inputs: binned [N, F] (or the bundled [N, G] group matrix when ``efb``
@@ -130,7 +134,7 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
               max_depth=max_depth, block_rows=block_rows, axis=axis,
               efb=efb, split_batch=split_batch, mono=mono,
               mono_penalty=mono_penalty, sparse=sparse,
-              padded_leaves=padded_leaves)
+              padded_leaves=padded_leaves, quant=quant)
     inner = _make_dp_owner_grower(mesh, **kw) if owner_shard \
         else _make_dp_psum_grower(mesh, **kw)
 
@@ -157,9 +161,29 @@ class _CollectiveGate:
         return getattr(self._inner, name)
 
 
+def _quant_hooks(axis: str, ledger: CommLedger, quant,
+                 site: str = "dp.quant_scale"):
+    """Quantized-training hooks for the row-sharded learners: the [3]
+    scale vector pmaxes across the mesh so every shard quantizes with
+    the GLOBAL per-iteration scale, and the stochastic-rounding stream
+    is keyed by GLOBAL row ids via this shard's row offset — together
+    they make the int32 histogram reduce bitwise dp==serial
+    (ops/quantize.py module docstring).  ``site`` names the pmax in the
+    comm ledger — the voting learner reuses these hooks under its own
+    label."""
+    if quant is None:
+        return dict(quant=None)
+    return dict(
+        quant=quant,
+        scale_reduce=lambda s: ledger.pmax(s, axis, site=site,
+                                           cadence="tree"),
+        row_offset=lambda n_local: lax.axis_index(axis) * n_local)
+
+
 def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                           max_depth, block_rows, axis, efb, split_batch,
-                          mono, mono_penalty, sparse, padded_leaves=None):
+                          mono, mono_penalty, sparse, padded_leaves=None,
+                          quant=None):
     """Owner-shard data-parallel grower (see module docstring)."""
     n_shards = mesh.shape[axis]
     out_specs = _dp_out_specs(axis)
@@ -228,6 +252,7 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
             efb=efb, split_batch=split_batch, mono=mono,
             mono_view=None if mono is None else mono_view,
             mono_penalty=mono_penalty, padded_leaves=padded_leaves,
+            **_quant_hooks(axis, ledger, quant),
             jit=False)
 
         def _localize(fmask, nb, na, ic):
@@ -245,32 +270,35 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
             from ..sparse_data import SparseBinned
             stride, nfs = sparse_key
 
-            def wrapped(flat, db, vals, fmask, nb, na, nabp, ic, ml):
+            def wrapped(flat, db, vals, fmask, nb, na, nabp, ic, ml, ri):
                 fm_l, nb_l, na_l, ic_l = _localize(fmask, nb, na, ic)
                 return inner(SparseBinned(flat, db, stride, nfs), vals,
-                             fm_l, nb_l, na_l, nabp, ic_l,
+                             fm_l, nb_l, na_l, nabp, ic_l, rng_iter=ri,
                              num_bin_part=nb, max_leaves=ml)
 
             in_specs = (P(axis, None), P(None), P(axis, None),
-                        P(), P(), P(), P(), P(), P())
+                        P(), P(), P(), P(), P(), P(), P())
         else:
-            def wrapped(binned, vals, fmask, nb, na, nabp, ic, ml):
+            def wrapped(binned, vals, fmask, nb, na, nabp, ic, ml, ri):
                 fm_l, nb_l, na_l, ic_l = _localize(fmask, nb, na, ic)
                 return inner(binned, vals, fm_l, nb_l, na_l, nabp, ic_l,
-                             num_bin_part=nb, max_leaves=ml)
+                             rng_iter=ri, num_bin_part=nb, max_leaves=ml)
 
             in_specs = (P(axis, None), P(axis, None),
-                        P(), P(), P(), P(), P(), P())
+                        P(), P(), P(), P(), P(), P(), P())
 
         fn = jax.jit(shard_map(wrapped, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False))
         return fn, plan
 
     def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None,
-             max_leaves=None):
+             max_leaves=None, rng_iter=None):
         if is_cat is None:
             is_cat = jnp.zeros(num_bin.shape[0], bool)
         ml = jnp.int32(num_leaves if max_leaves is None else max_leaves)
+        # always a traced argument (0 when unused) so the jit signature
+        # is stable whether or not quantized rounding consumes it
+        ri = jnp.int32(0 if rng_iter is None else rng_iter)
         nf = int(num_bin.shape[0])
         if sparse:
             key = (nf, binned.stride, binned.num_features)
@@ -280,13 +308,13 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
             fn, plan = cache[key]
             grow.plan = plan
             return fn(binned.flat, binned.default_bin, vals, feature_mask,
-                      num_bin, na_bin, na_bin, is_cat, ml)
+                      num_bin, na_bin, na_bin, is_cat, ml, ri)
         if nf not in cache:
             cache[nf] = _build(nf)
         fn, plan = cache[nf]
         grow.plan = plan
         return fn(binned, vals, feature_mask, num_bin, na_bin, na_bin,
-                  is_cat, ml)
+                  is_cat, ml, ri)
 
     grow.owner_shard = True
     grow.comm = ledger
@@ -298,7 +326,8 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
 
 def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                          max_depth, block_rows, axis, efb, split_batch,
-                         mono, mono_penalty, sparse, padded_leaves=None):
+                         mono, mono_penalty, sparse, padded_leaves=None,
+                         quant=None):
     """Legacy full-psum data-parallel grower: every shard receives ALL
     global histograms and recomputes the split decision replicated (no
     separate best-split sync needed — but per-chip histogram state scales
@@ -307,12 +336,14 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
     inner = make_grower(
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
-        hist_reduce=lambda h: ledger.psum(h, axis, site="dp.hist_psum"),
+        hist_reduce=lambda h, scales=None: ledger.psum(
+            h, axis, site="dp.hist_psum"),
         sum_reduce=lambda t: ledger.psum(t, axis, site="dp.root_sum",
                                          cadence="tree"),
         efb=efb,
         split_batch=split_batch, mono=mono, mono_penalty=mono_penalty,
-        padded_leaves=padded_leaves, jit=False)
+        padded_leaves=padded_leaves,
+        **_quant_hooks(axis, ledger, quant), jit=False)
 
     out_specs = _dp_out_specs(axis)
 
@@ -327,51 +358,55 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
         cache = {}
 
         def _sparse_fn(stride: int, nf: int):
-            def wrapped(flat, db, vals, fm, nb, nab, nabp, ic, ml):
+            def wrapped(flat, db, vals, fm, nb, nab, nabp, ic, ml, ri):
                 return inner(SparseBinned(flat, db, stride, nf), vals,
-                             fm, nb, nab, nabp, ic, max_leaves=ml)
+                             fm, nb, nab, nabp, ic, rng_iter=ri,
+                             max_leaves=ml)
             return shard_map(
                 wrapped, mesh=mesh,
                 in_specs=(P(axis, None), P(None), P(axis, None),
-                          P(), P(), P(), P(), P(), P()),
+                          P(), P(), P(), P(), P(), P(), P()),
                 out_specs=out_specs, check_vma=False)
 
         def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None,
-                 max_leaves=None):
+                 max_leaves=None, rng_iter=None):
             if is_cat is None:
                 is_cat = jnp.zeros(num_bin.shape[0], bool)
             ml = jnp.int32(num_leaves if max_leaves is None else max_leaves)
+            ri = jnp.int32(0 if rng_iter is None else rng_iter)
             key = (binned.stride, binned.num_features)
             if key not in cache:
                 cache[key] = jax.jit(_sparse_fn(*key))
             return cache[key](binned.flat, binned.default_bin, vals,
                               feature_mask, num_bin, na_bin, na_bin,
-                              is_cat, ml)
+                              is_cat, ml, ri)
 
         grow.owner_shard = False
         grow.comm = ledger
         return grow
 
-    def _dense(b, v, fm, nb, na, ic, ml):
+    def _dense(b, v, fm, nb, na, ic, ml, ri):
         # na doubles as na_bin_part (the old outside-the-shard_map
-        # duplication, folded in), so _dense has 7 params — in_specs
+        # duplication, folded in), so _dense has 8 params — in_specs
         # must match that arity, not inner's
-        return inner(b, v, fm, nb, na, na, ic, max_leaves=ml)
+        return inner(b, v, fm, nb, na, na, ic, rng_iter=ri, max_leaves=ml)
 
     f = shard_map(
         _dense, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P(),
+                  P()),
         out_specs=out_specs, check_vma=False)
 
     jitted = jax.jit(f)
 
     def grow(binned, vals, feature_mask, num_bin, na_bin, is_cat=None,
-             max_leaves=None):
+             max_leaves=None, rng_iter=None):
         if is_cat is None:
             is_cat = jnp.zeros(num_bin.shape[0], bool)
         ml = jnp.int32(num_leaves if max_leaves is None else max_leaves)
+        ri = jnp.int32(0 if rng_iter is None else rng_iter)
         return jitted(binned, vals, feature_mask, num_bin, na_bin, is_cat,
-                      ml)
+                      ml, ri)
 
     grow.owner_shard = False
     grow.comm = ledger

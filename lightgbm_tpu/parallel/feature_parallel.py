@@ -11,12 +11,26 @@ locally — no row data ever moves.
 Implemented as hooks into the shared grower program (grower.py):
 ``hist_view`` slices this shard's columns, ``select_best`` globalizes the
 feature index and reduces candidates across the mesh axis.
+
+Quantized training (``quant``) threads straight through: rows are
+replicated, so every shard computes the IDENTICAL per-iteration scale
+and rounding stream with no extra collective (global row id == local
+row id, ops/quantize.py).
+
+Leaf-budget trace sharing (ROADMAP item 1 remainder): ``padded_leaves``
++ per-call traced ``max_leaves`` + a process-level memo of the jitted
+shard_map program, so a ``num_leaves`` sweep inside one bucket runs ONE
+feature-parallel grower trace (pinned by tools/check_retraces.py).
 """
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -24,12 +38,19 @@ from ..grower import TreeArrays, make_grower
 from ..obs.comm import CommLedger
 from ..ops.split import SplitParams, SplitResult, gather_best
 from ..utils.jax_compat import shard_map
+from ..utils.memo import memo_get_or_build
+
+# process-level memo of jitted feature-parallel growers (the voting
+# learner's pattern; see parallel/voting_parallel.py)
+_SHARED: "OrderedDict[tuple, tuple]" = OrderedDict()
+_SHARED_MAX = 16
+_SHARED_LOCK = threading.Lock()
 
 
 def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
                    num_bins: int, params: SplitParams, max_depth: int = -1,
                    block_rows: int = 0, axis: str = "feature",
-                   split_batch: int = 1):
+                   split_batch: int = 1, padded_leaves=None, quant=None):
     """Jitted feature-parallel ``grow_tree``.
 
     Inputs: binned [N, F] and vals replicated; feature metadata arrays
@@ -42,12 +63,47 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
     if num_features % n_shards != 0:
         raise ValueError(f"num_features {num_features} must divide over "
                          f"{n_shards} shards (pad with masked features)")
+    key = (tuple(int(d.id) for d in np.ravel(mesh.devices)), axis,
+           int(num_features),
+           int(padded_leaves) if padded_leaves else None,
+           None if padded_leaves else int(num_leaves),
+           int(num_bins), params, int(max_depth), int(block_rows),
+           int(split_batch), quant)
+    jitted, ledger = memo_get_or_build(
+        _SHARED, _SHARED_LOCK, _SHARED_MAX, key,
+        lambda: _build(mesh, num_features=num_features,
+                       num_leaves=num_leaves, num_bins=num_bins,
+                       params=params, max_depth=max_depth,
+                       block_rows=block_rows, axis=axis,
+                       split_batch=split_batch,
+                       padded_leaves=padded_leaves, quant=quant))
+
+    def grow(binned, vals, feature_mask, num_bin, na_bin, na_bin_part=None,
+             is_cat=None, max_leaves=None, rng_iter=None):
+        if na_bin_part is None:
+            na_bin_part = na_bin
+        if is_cat is None:
+            is_cat = jnp.zeros(num_bin.shape[0], bool)
+        ml = jnp.int32(num_leaves if max_leaves is None else max_leaves)
+        ri = jnp.int32(0 if rng_iter is None else rng_iter)
+        return jitted(binned, vals, feature_mask, num_bin, na_bin,
+                      na_bin_part, is_cat, ml, ri)
+
+    grow.comm = ledger
+    return grow
+
+
+def _build(mesh: Mesh, *, num_features, num_leaves, num_bins, params,
+           max_depth, block_rows, axis, split_batch, padded_leaves,
+           quant):
+    n_shards = mesh.shape[axis]
     f_local = num_features // n_shards
     ledger = CommLedger(n_shards)     # static comm-bytes sites (obs/comm)
 
     def hist_view(binned):
         idx = lax.axis_index(axis)
-        return lax.dynamic_slice_in_dim(binned, idx * f_local, f_local, axis=1)
+        return lax.dynamic_slice_in_dim(binned, idx * f_local, f_local,
+                                        axis=1)
 
     def select_best(res: SplitResult) -> SplitResult:
         # contiguous slices globalize by offset; the winner sync is the
@@ -61,27 +117,22 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
         hist_view=hist_view, select_best=select_best,
-        split_batch=split_batch, jit=False)
+        split_batch=split_batch, padded_leaves=padded_leaves,
+        # rows replicated: identical scales/rounding on every shard —
+        # no scale pmax or row offset needed (module docstring)
+        quant=quant, jit=False)
 
     out_specs = jax.tree.map(lambda _: P(), TreeArrays(
         *(0,) * len(TreeArrays._fields)))
 
+    def wrapped(binned, vals, fm, nb, na, nabp, ic, ml, ri):
+        return inner(binned, vals, fm, nb, na, nabp, ic, rng_iter=ri,
+                     max_leaves=ml)
+
     f = shard_map(
-        inner, mesh=mesh,
+        wrapped, mesh=mesh,
         in_specs=(P(None, None), P(None, None), P(axis), P(axis), P(axis),
-                  P(None), P(axis)),
+                  P(None), P(axis), P(), P()),
         out_specs=out_specs, check_vma=False)
 
-    jitted = jax.jit(f)
-
-    def grow(binned, vals, feature_mask, num_bin, na_bin, na_bin_part=None,
-             is_cat=None):
-        if na_bin_part is None:
-            na_bin_part = na_bin
-        if is_cat is None:
-            is_cat = jnp.zeros(num_bin.shape[0], bool)
-        return jitted(binned, vals, feature_mask, num_bin, na_bin,
-                      na_bin_part, is_cat)
-
-    grow.comm = ledger
-    return grow
+    return jax.jit(f), ledger

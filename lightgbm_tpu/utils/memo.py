@@ -1,0 +1,42 @@
+"""Process-level memo of jitted programs: get-or-build with LRU
+eviction.
+
+The distributed grower builders (parallel/voting_parallel.py,
+parallel/feature_parallel.py) memoize their jitted shard_map programs
+process-wide so a leaf sweep inside one padded bucket shares ONE trace
+across Boosters (the role grower.py's ``_SHARED_GROWERS`` plays for the
+serial grower).  Each module keeps its own store/lock; this helper owns
+the get/move-to-end/insert/evict discipline so the three copies cannot
+drift.
+
+``build`` runs OUTSIDE the lock — tracing can take seconds and must not
+serialize unrelated Boosters.  A concurrent duplicate build is benign:
+last writer wins the store slot, both handles stay live (eviction only
+drops the shared handle, never a Booster's own reference).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+def memo_get_or_build(store: "OrderedDict",
+                      lock: threading.Lock,
+                      max_entries: int,
+                      key,
+                      build: Callable[[], T]) -> T:
+    with lock:
+        hit = store.get(key)
+        if hit is not None:
+            store.move_to_end(key)
+            return hit
+    out = build()
+    with lock:
+        store[key] = out
+        while len(store) > max_entries:
+            store.popitem(last=False)
+    return out

@@ -53,13 +53,15 @@ BUDGET = os.path.join(REPO, "tools", "perf_budget.txt")
 
 # direction-by-name defaults for --update: latency/compile/freshness
 # metrics gate downward, everything else (rates, MFU) upward
-_LOWER_BETTER = re.compile(r"(_ms|compile_s|_seconds|_lag_s|_gen_s)$")
+_LOWER_BETTER = re.compile(
+    r"(_ms|compile_s|_seconds|_lag_s|_gen_s|_hbm_bytes_per_iter)$")
 # extras worth gating by default: primary value, throughput points,
 # serve latency/throughput (host-accumulation AND fused device paths),
 # mfu, and the continual pipeline's freshness numbers
 _GATEABLE = re.compile(
     r"(^value$|_iters_per_sec$|^serve(_device)?_rows_per_s$"
     r"|^serve(_device)?_p\d+_ms$|_mfu$|_compile_s$"
+    r"|^hist_hbm_bytes_per_iter$"
     r"|^continual_(freshness_lag_s|gen_s)$)")
 _DEFAULT_TOL = {"higher": 0.20, "lower": 0.30}
 

@@ -6,6 +6,16 @@ we report device-time-per-pass = wall / R.
 
 Run: python tools/bench_hist.py [n_rows] [R]
 
+--quant {off,8,16}: quantized-training sweep instead — the SHIPPED
+``compute_histogram`` (f32 vs int8/int16 packed accumulands,
+ops/quantize.py) across split_batch-shaped slot widths K in {16,32,64},
+reporting ms/pass, achieved TFLOP/s, and the static per-pass HBM bytes
+from the shared ledger formula (obs/flops.py).  ``run_quant_bench`` is
+the importable entry bench.py folds into its extras as ``hist_quant_*``
+keys.  Default (no value) runs all three.
+
+Run: python tools/bench_hist.py --quant [8] [n_rows] [R]
+
 --sharded: microbench the data-parallel histogram REDUCTION instead —
 owner-shard ``psum_scatter`` (each shard keeps [ceil(F/n), B, 3] of global
 histograms) vs the legacy full ``psum`` ([F, B, 3] replicated to every
@@ -166,6 +176,87 @@ def sharded_main():
                   file=sys.stderr, flush=True)
 
 
+def run_quant_bench(n_rows: int = 200_000, reps: int = 5,
+                    quants=("off", "8", "16"), ks=(16, 32, 64),
+                    f: int = 28, num_bins: int = 63) -> dict:
+    """Quantized-vs-f32 histogram contraction sweep over the
+    split_batch slot widths K — the SHIPPED kernel (compute_histogram),
+    not a bench-local variant, so dtype dispatch, block sizing
+    (hist_block_rows by vals itemsize) and the int32 accumulation are
+    exactly what training runs.  Returns a flat dict bench.py folds
+    into extras as ``hist_quant_<key>``."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    from lightgbm_tpu.obs.flops import hist_flops_bytes, padded_bins
+    from lightgbm_tpu.obs.trace import fence
+    from lightgbm_tpu.ops.histogram import compute_histogram
+    from lightgbm_tpu.ops.quantize import (QuantSpec, quant_scales,
+                                           quantize_stack)
+
+    rng = np.random.RandomState(0)
+    binned = _jnp.asarray(rng.randint(0, num_bins, size=(n_rows, f),
+                                      dtype=np.uint8))
+    vals_f32 = _jnp.asarray(rng.randn(n_rows, 3).astype(np.float32))
+    out = {}
+    for q in quants:
+        if q == "off":
+            vals, isz = vals_f32, 4
+        else:
+            spec = QuantSpec(bits=int(q))
+            scales = quant_scales(vals_f32, spec.qmax)
+            vals = quantize_stack(vals_f32, scales, spec,
+                                  _jnp.int32(0), 0)
+            isz = spec.itemsize
+        for k in ks:
+            slot = _jnp.asarray(
+                rng.randint(0, k, size=n_rows, dtype=np.int32))
+
+            @_jax.jit
+            def rep(b, v, s, _k=k):
+                def body(i, acc):
+                    h = compute_histogram(b, v, num_bins=num_bins,
+                                          slot=s + 0 * i, num_slots=_k)
+                    return acc + h.astype(_jnp.float32)
+                z = compute_histogram(b, v, num_bins=num_bins, slot=s,
+                                      num_slots=_k)
+                return lax.fori_loop(0, reps, body,
+                                     jnp.zeros_like(z, jnp.float32))
+
+            fence(rep(binned, vals, slot))
+            t0 = time.perf_counter()
+            fence(rep(binned, vals, slot))
+            t = (time.perf_counter() - t0) / reps
+            fl, hb = hist_flops_bytes(n_rows, f, num_bins,
+                                      channels=3 * k, vals_itemsize=isz)
+            out[f"q{q}_k{k}_ms_per_pass"] = round(t * 1e3, 3)
+            out[f"q{q}_k{k}_tflops"] = round(fl / t / 1e12, 4)
+        _, hb1 = hist_flops_bytes(n_rows, f, num_bins, channels=3,
+                                  vals_itemsize=isz)
+        out[f"q{q}_hbm_bytes_per_pass"] = hb1
+    out.update(n_rows=n_rows, f=f, num_bins=num_bins,
+               padded_bins=padded_bins(num_bins), reps=reps)
+    return out
+
+
+def quant_main():
+    import json
+    args = [a for a in sys.argv[1:] if a != "--quant"]
+    quants = ("off", "8", "16")
+    if args and args[0] in ("off", "8", "16"):
+        quants = (args.pop(0),)
+    n = int(args[0]) if args else 200_000
+    reps = int(args[1]) if len(args) > 1 else 5
+    rec = run_quant_bench(n_rows=n, reps=reps, quants=quants)
+    rec["bench"] = "hist_quant"
+    rec["platform"] = jax.devices()[0].platform
+    print(json.dumps(rec), flush=True)
+    for k in sorted(rec):
+        if k.endswith("_ms_per_pass"):
+            print(f"  {k} = {rec[k]} ms "
+                  f"({rec[k.replace('_ms_per_pass', '_tflops')]} TF/s)",
+                  file=sys.stderr, flush=True)
+
+
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
     R = int(sys.argv[2]) if len(sys.argv) > 2 else 20
@@ -246,4 +337,9 @@ def main():
 
 
 if __name__ == "__main__":
-    sharded_main() if SHARDED else main()
+    if SHARDED:
+        sharded_main()
+    elif "--quant" in sys.argv:
+        quant_main()
+    else:
+        main()
