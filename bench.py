@@ -658,13 +658,22 @@ def main():
 
     errors = []
     # --- 1. probe the TPU claim (wedge detection, see module docstring) --
+    # claim_reason classifies the TPU-loss story machine-readably
+    # (ISSUE 14 satellite — BENCH_r03-r05 lost the claim with only
+    # free-text diagnoses): "wedge" = claim hung past the probe budget,
+    # "no_claim" = fast refusal (Unavailable/backend error), "preempt"
+    # = claim granted but the measurement child lost it mid-run
     tpu_ok = False
+    claim_reason = None
     for i in range(2):
         t0 = time.time()
         out, err = run_child("probe", timeout=PROBE_TIMEOUT, orphan=True)
         if "PROBE_OK" in (out or ""):
             tpu_ok = True
+            claim_reason = None
             break
+        claim_reason = "wedge" if (err and "timeout" in err) \
+            else "no_claim"
         diag = ("wedged: claim hung (timeout-killed client holds the "
                 "relay grant)" if err and "timeout" in err
                 else f"claim failed fast ({err}) after "
@@ -675,7 +684,8 @@ def main():
         if err and "timeout" in err:
             break                    # a wedge does not clear in 30 s
         time.sleep(30)               # fast Unavailable may be transient
-    _record_point("probe", tpu_ok=tpu_ok, errors=errors[:])
+    _record_point("probe", tpu_ok=tpu_ok, reason=claim_reason,
+                  errors=errors[:])
 
     # --- 2. primary point (hard-capped) ---------------------------------
     line = None
@@ -691,6 +701,10 @@ def main():
             line = _metric_line(out)
             if not line:
                 errors.append(f"primary-quick: {err or 'no JSON line'}")
+                # the probe was granted but the measurement lost the
+                # device mid-run — the preemption story, distinct from
+                # never having claimed at all
+                claim_reason = "preempt"
 
     # --- 2b. prefer a TPU point captured mid-round over any fallback ----
     # tools/tpu_watch.py waits out the tunnel wedge all round and runs
@@ -724,6 +738,30 @@ def main():
                   f"({captured.get('t', 'no timestamp')})",
                   file=sys.stderr, flush=True)
 
+    # --- 2c. ONE elastic re-acquire attempt before degrading to CPU ----
+    # (ISSUE 14 satellite): a preempted claim or fast refusal may have
+    # cleared by now — one cheap re-probe + quick primary salvages the
+    # hardware number.  A WEDGE is excluded: it does not clear on this
+    # timescale (r03-r05), and tools/tpu_watch.py already owns the
+    # wait-out-the-wedge strategy; re-probing would only burn the
+    # probe budget twice.
+    reacquired = None
+    if not line and claim_reason in ("preempt", "no_claim"):
+        print(f"[bench] elastic re-acquire after {claim_reason}...",
+              file=sys.stderr, flush=True)
+        out, err = run_child("probe", timeout=PROBE_TIMEOUT, orphan=True)
+        if "PROBE_OK" in (out or ""):
+            out, err = run_child("primary", timeout=QUICK_TIMEOUT,
+                                 extra_env={"_BENCH_QUICK": "1"})
+            line = _metric_line(out)
+            reacquired = bool(line)
+            if not line:
+                errors.append(f"reacquire-primary: {err or 'no JSON line'}")
+        else:
+            reacquired = False
+            errors.append("reacquire: no claim")
+        _record_point("reacquire", ok=reacquired, reason=claim_reason)
+
     degraded = None
     cpu_fallback = False
     if not line:
@@ -755,10 +793,17 @@ def main():
     if not line:
         rec = {"metric": METRIC, "value": 0.0, "unit": "iters/s",
                "vs_baseline": 0.0, "error": "; ".join(errors)}
+        if claim_reason:
+            rec["claim"] = {"reason": claim_reason,
+                            "reacquired": reacquired}
         _record_point("final", **rec)
         print(json.dumps(rec), flush=True)
         return
     rec = json.loads(line)
+    if claim_reason:
+        # the TPU-loss story rides the final record's provenance: WHY
+        # this round's number is degraded/captured, machine-readably
+        rec["claim"] = {"reason": claim_reason, "reacquired": reacquired}
     extra = {}
     for p in _read_points(points_src):
         name = p.get("point")

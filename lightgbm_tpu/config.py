@@ -292,6 +292,37 @@ _PARAMS: Dict[str, tuple] = {
     # when multi-chip bring-up exhausts its retries, degrade to the
     # serial learner with a logged warning instead of raising
     "dist_fallback_serial": (bool, False, []),
+    # ---- elastic training (lightgbm_tpu/parallel/elastic.py) ----
+    # master switch for the elastic liveness + recovery layer: the
+    # training loop's host fetch runs under the collective deadline,
+    # the device claim under a cancel-and-raise watchdog, peers are
+    # liveness-checked per iteration, and snapshot params-signatures
+    # treat the topology (tree_learner=data|serial, mesh_shape,
+    # num_machines) as volatile so a shrunk mesh can resume the same
+    # run.  false (default) keeps every path byte-identical to before
+    "elastic_enable": (bool, False, []),
+    # per-iteration collective deadline (seconds): the training loop's
+    # one host fetch — where every queued collective actually blocks —
+    # is abandoned past this and classified as
+    # ElasticFailure("collective_timeout"); 0 disables the deadline
+    "elastic_collective_timeout_s": (float, 300.0, []),
+    # heartbeat cadence of the per-process liveness thread (elastic
+    # ladder runs only; requires elastic_heartbeat_dir)
+    "elastic_heartbeat_interval_s": (float, 1.0, []),
+    # a peer whose heartbeat file is staler than this is declared lost
+    # (ElasticFailure("host_loss"))
+    "elastic_heartbeat_timeout_s": (float, 10.0, []),
+    # shared directory for heartbeat files (one hb_<process>.json per
+    # process); empty disables the heartbeat layer
+    "elastic_heartbeat_dir": (str, "", []),
+    # wall-clock budget (seconds) for one recovery episode: from the
+    # first classified failure until training runs again, across all
+    # retry/shrink attempts; past it the ladder re-raises.  0 = no
+    # budget
+    "elastic_recover_timeout_s": (float, 600.0, []),
+    # same-rung retries (jittered backoff) before the ladder shrinks
+    # the mesh; host_loss always shrinks immediately
+    "elastic_retries": (int, 1, []),
     # check grad/hess and new-tree leaf outputs for non-finite values
     # every k iterations (one amortized scalar sync; fused-chunk
     # compatible); 0 disables
@@ -737,6 +768,21 @@ class Config:
                              "(0 = no gate timeout)")
         if self.shadow_probe_batches < 0:
             raise ValueError("shadow_probe_batches must be >= 0")
+        for knob in ("elastic_collective_timeout_s",
+                     "elastic_recover_timeout_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0 (0 disables)")
+        if self.elastic_heartbeat_interval_s <= 0:
+            raise ValueError("elastic_heartbeat_interval_s must be > 0")
+        if self.elastic_heartbeat_timeout_s \
+                <= self.elastic_heartbeat_interval_s:
+            # a deadline at or under the write cadence declares every
+            # healthy peer dead on scheduler jitter alone
+            raise ValueError(
+                "elastic_heartbeat_timeout_s must exceed "
+                "elastic_heartbeat_interval_s")
+        if self.elastic_retries < 0:
+            raise ValueError("elastic_retries must be >= 0")
         for knob in ("shadow_probe_tolerance",
                      "shadow_probe_metric_tolerance",
                      "shadow_probe_lineage_tolerance"):

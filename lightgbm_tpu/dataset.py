@@ -95,6 +95,25 @@ class Metadata:
         return len(self.query_boundaries) - 1
 
 
+def fingerprint_arrays(label, weight=None) -> str:
+    """The snapshot data fingerprint as a pure function of label/weight
+    arrays — shared by :meth:`Dataset.fingerprint` and the elastic
+    multi-process snapshot writer (``GBDTModel.snapshot_state``), which
+    must stamp the GLOBAL gathered arrays with byte-identical hashing
+    so a shrunk relaunch over the full data matches the manifest."""
+    import hashlib
+    h = hashlib.sha256()
+    if label is None:
+        h.update(b"unlabeled")
+    else:
+        lab = np.asarray(label, np.float32).reshape(-1)
+        h.update(str(len(lab)).encode())
+        h.update(lab.tobytes())
+    if weight is not None:
+        h.update(np.asarray(weight, np.float32).reshape(-1).tobytes())
+    return h.hexdigest()[:16]
+
+
 def _is_scipy_sparse(data) -> bool:
     return hasattr(data, "tocsc") and hasattr(data, "nnz")
 
@@ -1019,8 +1038,6 @@ class Dataset:
         matches the check a resuming run performs on its yet-unbinned
         dataset.  A guard against resuming onto the wrong data — not a
         cryptographic identity of the feature matrix."""
-        import hashlib
-        h = hashlib.sha256()
         lab = wgt = None
         if self.metadata is not None:
             lab, wgt = self.metadata.label, self.metadata.weight
@@ -1028,15 +1045,7 @@ class Dataset:
             lab = getattr(self, "_label_in", None)
         if wgt is None:
             wgt = getattr(self, "_weight_in", None)
-        if lab is None:
-            h.update(b"unlabeled")
-        else:
-            lab = np.asarray(lab, np.float32).reshape(-1)
-            h.update(str(len(lab)).encode())
-            h.update(lab.tobytes())
-        if wgt is not None:
-            h.update(np.asarray(wgt, np.float32).reshape(-1).tobytes())
-        return h.hexdigest()[:16]
+        return fingerprint_arrays(lab, wgt)
 
     # -- binary cache ----------------------------------------------------
     def save_binary(self, path: str) -> None:

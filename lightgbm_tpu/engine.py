@@ -122,6 +122,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # continues training bit-exactly where the crash hit (a
             # re-prediction of the snapshot model would differ in the
             # last ulp and change the trees grown after the resume)
+            row_range = getattr(train_set, "elastic_row_range", None)
+            if row_range is not None:
+                # elastic multi-process resume: the snapshot carries
+                # the GLOBAL score (GBDTModel.snapshot_state); this
+                # process feeds back only its own shard's rows
+                snap_score = snap_score[row_range[0]:row_range[1]]
             train_set.set_init_score(np.asarray(snap_score, np.float64))
             Log.info(f"auto-resume: continuing from {snap_path} "
                      f"(iteration {resume_start})")

@@ -110,6 +110,20 @@ class GBDTModel:
         import jax as _jax
         self._pc = _jax.process_count()   # >1 = one controller per host
 
+        # elastic liveness layer (parallel/elastic.py): when enabled,
+        # the per-iteration host fetch runs under the collective
+        # deadline and peers are heartbeat-checked each iteration.
+        # Disabled (default) costs one None test per fetch — every
+        # path stays byte-identical to before
+        self._elastic = None
+        self._elastic_timeout = 0.0
+        if getattr(config, "elastic_enable", False):
+            from ..parallel import elastic as _elastic
+            self._elastic = _elastic
+            self._elastic_timeout = float(
+                config.elastic_collective_timeout_s)
+        self._global_fp = None      # cached global data fingerprint
+
         # learner selection (the device_type axis, tree_learner.cpp:16-64):
         # - partitioned: host-orchestrated, histogram work ∝ smaller child —
         #   wins when dispatch is cheap (CPU) or trees are huge
@@ -823,25 +837,55 @@ class GBDTModel:
         from ..parallel import make_mesh
         from ..utils import faultinject
         from ..utils.log import Log
-        from ..utils.resilience import RetryPolicy, Watchdog, retry_call
+        from ..utils.resilience import (RetryPolicy, Watchdog,
+                                        WatchdogTimeout, retry_call)
 
         def _claim():
             faultinject.check("device_claim")
+            faultinject.check("claim_wedge")
             return jax.devices()
 
         timeout = config.dist_init_timeout_s
+        elastic = bool(getattr(config, "elastic_enable", False))
         policy = RetryPolicy.for_bringup(config.dist_init_retries, timeout)
         try:
-            with Watchdog(timeout, label="device claim"):
-                devs = retry_call(_claim, policy=policy,
-                                  label="device claim")
+            if elastic:
+                # cancel-and-raise: a WEDGED claim (the round-5 / bench
+                # r03-r05 failure) is abandoned at its deadline slice
+                # and becomes a retryable WatchdogTimeout.  The
+                # per-attempt slice is timeout/attempts — a wedge
+                # abandoned at the FULL timeout would exhaust
+                # retry_call's deadline_s (== timeout) on the first
+                # attempt and dist_init_retries would never fire.
+                # Exhaustion surfaces as a classified ElasticFailure
+                # for the recovery ladder
+                per_attempt = timeout / max(1, policy.max_attempts)
+                devs = retry_call(
+                    lambda: Watchdog(per_attempt, label="device claim",
+                                     on_timeout="raise").run(_claim),
+                    policy=policy, label="device claim")
+            else:
+                with Watchdog(timeout, label="device claim"):
+                    devs = retry_call(_claim, policy=policy,
+                                      label="device claim")
         except Exception as e:
+            fail = None
+            if elastic and isinstance(e, WatchdogTimeout):
+                # classify + record (elastic.* metrics, JSONL event,
+                # blackbox dump) BEFORE the fallback decision — a wedge
+                # must never be silent, even when dist_fallback_serial
+                # then degrades it to the serial learner
+                from ..parallel.elastic import ElasticFailure, _on_failure
+                fail = ElasticFailure("claim_wedge", str(e))
+                _on_failure(fail, site="device_claim")
             if config.dist_fallback_serial:
                 Log.warning(
                     f"multi-chip bring-up failed after "
                     f"{policy.max_attempts} attempt(s) ({e}); falling back "
                     "to the serial learner (dist_fallback_serial=true)")
                 return None
+            if fail is not None:
+                raise fail from e
             raise
         if config.mesh_shape and len(config.mesh_shape) > 1:
             # the tree learners shard exactly one axis (rows OR features);
@@ -867,6 +911,70 @@ class GBDTModel:
                 "device is visible; training serially")
             return None
         return make_mesh((n,), (axis,), devs)
+
+    def _eget(self, x, site: str = "fetch"):
+        """The iteration's host fetch.  Under ``elastic_enable`` it runs
+        inside the collective deadline (``parallel/elastic.guarded_get``:
+        a wedged collective materializes at this blocking fetch, gets
+        stack-dumped, abandoned, and classified as an ElasticFailure
+        instead of hanging the run); otherwise a plain device fetch."""
+        if self._elastic is not None and self._elastic_timeout > 0:
+            return self._elastic.guarded_get(x, self._elastic_timeout,
+                                             site=site)
+        return jax.device_get(x)
+
+    def snapshot_state(self):
+        """``(score, fingerprint_override)`` for snapshot.write_snapshot.
+
+        Default: this process's score and no override.  Under elastic
+        MULTI-PROCESS row-sharded training the snapshot must instead
+        carry GLOBAL state — the all-process score in global row order
+        and the full-data fingerprint — so a shrunk (even
+        single-process) relaunch over the full data can locate and
+        resume it (docs/Fault-Tolerance.md "Elastic training")."""
+        if not (self._elastic is not None and self._pc > 1
+                and self._dist in ("data", "voting")
+                and self._global_counts is not None):
+            return np.asarray(self.score, np.float32), None
+        from jax.experimental import multihost_utils
+
+        def _allgather(arr, site):
+            # the allgather is itself a collective: a peer that died
+            # between the iteration's liveness check and this snapshot
+            # write would wedge it forever — bound it by the same
+            # elastic deadline as the training fetch so a snapshot
+            # boundary can never reopen the silent-hang class
+            return np.asarray(self._elastic.guarded_call(
+                lambda: multihost_utils.process_allgather(arr),
+                self._elastic_timeout, site))
+
+        counts = self._global_counts
+        tmax = int(counts.max())
+        sc = np.asarray(self.score, np.float32)
+        if sc.shape[0] < tmax:
+            sc = np.concatenate(
+                [sc, np.zeros((tmax - sc.shape[0], sc.shape[1]),
+                              np.float32)])
+        allsc = _allgather(sc, "snapshot_allgather")
+        gscore = np.concatenate(
+            [allsc[p, :int(counts[p])] for p in range(len(counts))])
+        if self._global_fp is None:
+            lab = np.asarray(self.train_set.metadata.label, np.float32)
+            w = self.train_set.metadata.weight
+            pad = tmax - len(lab)
+            cols = [np.pad(lab, (0, pad))]
+            if w is not None:
+                cols.append(np.pad(np.asarray(w, np.float32), (0, pad)))
+            g = _allgather(np.stack(cols), "snapshot_fp_allgather")
+            glab = np.concatenate(
+                [g[p, 0, :int(counts[p])] for p in range(len(counts))])
+            gw = None
+            if w is not None:
+                gw = np.concatenate(
+                    [g[p, 1, :int(counts[p])] for p in range(len(counts))])
+            from ..dataset import fingerprint_arrays
+            self._global_fp = fingerprint_arrays(glab, gw)
+        return gscore, self._global_fp
 
     def _prep_vals(self, vals: jax.Array) -> jax.Array:
         """Pad + row-shard the per-row (grad, hess, weight) stack for the
@@ -1339,6 +1447,8 @@ class GBDTModel:
         streams: feature masks are pre-drawn host-side, GOSS keys are
         seeded by iteration index in-graph).  Returns True when a
         no-split iteration occurred (trailing stump repeats discarded)."""
+        if self._elastic is not None:
+            self._elastic.check_peers()      # per-chunk liveness poll
         if self.valid_sets:
             raise ValueError("train_chunk requires no validation sets")
         if not self._fusable_config():
@@ -1380,7 +1490,7 @@ class GBDTModel:
                                                cuse0,
                                                jnp.int32(cfg.num_leaves))
         # the one sync per chunk (tree records + finite-guard flags)
-        host, bad_host = jax.device_get((stacked, bad_flags))
+        host, bad_host = self._eget((stacked, bad_flags), "fused_fetch")
         if obs is not None:
             _sp.end()                  # device_get above already blocked
             if obs.profiler is not None:
@@ -1478,6 +1588,12 @@ class GBDTModel:
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp:371 TrainOneIter).
         Returns True if training should stop (no splits possible)."""
+        if self._elastic is not None:
+            # per-iteration liveness poll (parallel/elastic.py): a peer
+            # whose heartbeat went stale becomes a classified
+            # ElasticFailure BEFORE this iteration queues collectives
+            # that would hang on the dead shard
+            self._elastic.check_peers()
         cfg = self.config
         obs = self._obs
         t_iter0 = obs.iter_begin(self.iter_) if obs is not None else 0.0
@@ -1618,7 +1734,7 @@ class GBDTModel:
                 # fields via one replicated fetch, this process's
                 # leaf_of_row rows from its own addressable shards.
                 small = arrays._replace(leaf_of_row=arrays.num_leaves)
-                host_g = jax.device_get(small)
+                host_g = self._eget(small, "fetch")
                 arrays = jax.tree.map(jnp.asarray, host_g)._replace(
                     leaf_of_row=self._localize_rows(arrays.leaf_of_row))
             elif self._row_pad:
@@ -1630,7 +1746,8 @@ class GBDTModel:
             # leaf_of_row stays on device (only pulled when renew/linear
             # paths need it) — matters when the chip is behind a tunnel
             small = arrays._replace(leaf_of_row=arrays.num_leaves)
-            host = jax.device_get(small)._replace(leaf_of_row=arrays.leaf_of_row)
+            host = self._eget(small, "fetch") \
+                ._replace(leaf_of_row=arrays.leaf_of_row)
             if obs is not None:
                 # device_get blocks by itself; no fence needed
                 obs.phase_metric("fetch", _sp.end())
@@ -1650,7 +1767,7 @@ class GBDTModel:
             elif fin_check:
                 fin_ok = bool(np.isfinite(leaf_values[:max(nl, 1)]).all())
                 if fin_ok and gh_ok is not None:
-                    fin_ok = bool(jax.device_get(gh_ok))
+                    fin_ok = bool(self._eget(gh_ok, "finite_check"))
                     gh_ok = None      # the one scalar sync per check
                 if not fin_ok:
                     msg = ("non-finite gradient/hessian or leaf output "

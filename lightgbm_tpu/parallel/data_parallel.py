@@ -50,7 +50,22 @@ from ..ops.histogram import pad_feature_axis
 from ..ops.split import (SplitParams, SplitResult, gather_best,
                          globalize_feature)
 from ..utils.jax_compat import shard_map
+from ..utils.memo import memo_get_or_build
 from .mesh import owner_shard_plan
+
+# process-level memo of built dp growers (the voting/feature builders'
+# _SHARED pattern, utils/memo.py): a leaf sweep inside one padded
+# bucket — and every Booster the elastic recovery ladder constructs on
+# the SAME topology while retrying a rung — shares one jitted program
+# per (mesh, config family) instead of re-tracing per Booster.  Keyed
+# through grower._grower_key so unkeyable configs simply build private
+# programs (never a correctness risk).
+import threading
+from collections import OrderedDict
+
+_SHARED: "OrderedDict[tuple, object]" = OrderedDict()
+_SHARED_LOCK = threading.Lock()
+_SHARED_MAX = 32
 
 
 def pad_to_multiple(n: int, k: int) -> int:
@@ -135,9 +150,24 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
               efb=efb, split_batch=split_batch, mono=mono,
               mono_penalty=mono_penalty, sparse=sparse,
               padded_leaves=padded_leaves, quant=quant)
-    inner = _make_dp_owner_grower(mesh, **kw) if owner_shard \
-        else _make_dp_psum_grower(mesh, **kw)
+    build = (lambda: _make_dp_owner_grower(mesh, **kw)) if owner_shard \
+        else (lambda: _make_dp_psum_grower(mesh, **kw))
 
+    from ..grower import _grower_key
+    kw_key = dict(kw)
+    if padded_leaves:
+        # the padded budget is the trace-relevant leaf dimension; the
+        # actual num_leaves rides in as the traced max_leaves argument,
+        # so 31/63 inside one bucket share the memo entry
+        kw_key["num_leaves"] = None
+    key_part = _grower_key(kw_key)
+    if key_part is None:
+        inner = build()
+    else:
+        key = (tuple(int(d.id) for d in np.ravel(mesh.devices)),
+               bool(owner_shard), key_part)
+        inner = memo_get_or_build(_SHARED, _SHARED_LOCK, _SHARED_MAX,
+                                  key, build)
     return _CollectiveGate(inner)
 
 

@@ -24,6 +24,7 @@ Typical pod usage (same script on every host)::
 
 from __future__ import annotations
 
+import time
 from typing import Callable, List, NamedTuple, Optional
 
 import numpy as np
@@ -32,11 +33,18 @@ from ..config import Config
 
 
 class RowShard(NamedTuple):
-    """This process's row partition."""
+    """This process's row partition.  ``weight`` and the global row
+    range ``[row_start, row_stop)`` are populated by :func:`row_shard`
+    (``row_stop == 0`` on direct per-host wraps where the global
+    placement is unknown) — keeping row/label/weight partitioning in
+    ONE authority so they cannot drift."""
     x: np.ndarray
     y: Optional[np.ndarray]
     process_index: int
     process_count: int
+    weight: Optional[np.ndarray] = None
+    row_start: int = 0
+    row_stop: int = 0
 
     def sample(self, cnt: int, seed: int = 3) -> np.ndarray:
         from ..dataset import _sample_rows
@@ -98,6 +106,17 @@ def init(coordinator_address: Optional[str] = None,
             if process_id is None:
                 raise ValueError(
                     f"local host not found in machines={machines!r}")
+    fail_t = getattr(init, "_fail_t", None)
+    if coordinator_address is None and fail_t is not None \
+            and timeout_s > 0 \
+            and time.monotonic() - fail_t < timeout_s:
+        # a recent AUTO bring-up failure: proceed solo without burning
+        # another full retry/watchdog budget per train() call.  The
+        # pre-elastic code latched _done here PERMANENTLY; a cooldown
+        # (one deadline's worth) keeps the failure retryable for the
+        # elastic ladder without re-paying the deadline every call
+        return
+
     def _bring_up():
         faultinject.check("device_claim")
         if coordinator_address is not None:
@@ -112,7 +131,13 @@ def init(coordinator_address: Optional[str] = None,
         with Watchdog(timeout_s, label="jax.distributed bring-up"):
             retry_call(_bring_up, policy=policy,
                        label="jax.distributed bring-up")
+        # latched ONLY on successful bring-up: a failed or timed-out
+        # initialize must stay retryable — the elastic recovery ladder
+        # (parallel/elastic.py) re-attempts bring-up after a claim
+        # wedge, and a latched failure would permanently short-circuit
+        # every later attempt into the degraded path
         init._done = True
+        init._fail_t = None
     except (RuntimeError, ValueError) as e:
         if coordinator_address is not None:
             # an explicitly-requested multi-host launch failing must be
@@ -123,16 +148,19 @@ def init(coordinator_address: Optional[str] = None,
                 f"coordinator {coordinator_address!r}: {e}") from e
         # auto-detect path on single-process / already-initialized
         # runtimes: proceed solo, the same way the reference CLI falls
-        # back to serial when num_machines=1 — but say so
+        # back to serial when num_machines=1 — but say so (and do NOT
+        # latch _done: the next caller may retry the bring-up once the
+        # cooldown above lapses)
+        init._fail_t = time.monotonic()
         from ..utils.log import Log
         Log.warning(f"jax.distributed auto-init unavailable ({e}); "
                     "continuing single-process")
-        init._done = True
 
 
 def row_shard(x: np.ndarray, y: Optional[np.ndarray] = None,
               process_index: Optional[int] = None,
-              process_count: Optional[int] = None) -> RowShard:
+              process_count: Optional[int] = None,
+              weight: Optional[np.ndarray] = None) -> RowShard:
     """Deterministic contiguous row partition of a globally-loaded array
     (the per-rank partitioning of dataset_loader.cpp:203-298).  When data
     is already loaded per-host, wrap it in a RowShard directly."""
@@ -142,7 +170,11 @@ def row_shard(x: np.ndarray, y: Optional[np.ndarray] = None,
     parts = np.array_split(np.arange(len(x)), pc)
     idx = parts[pi]
     return RowShard(x=x[idx], y=None if y is None else y[idx],
-                    process_index=pi, process_count=pc)
+                    process_index=pi, process_count=pc,
+                    weight=None if weight is None
+                    else np.asarray(weight)[idx],
+                    row_start=int(idx[0]) if len(idx) else 0,
+                    row_stop=int(idx[-1]) + 1 if len(idx) else 0)
 
 
 def global_bin_mappers(local_sample: np.ndarray, config: Config,

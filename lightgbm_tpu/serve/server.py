@@ -358,7 +358,24 @@ class Server:
         except (TypeError, ValueError):
             timeout_s = self.config.serve_drain_s
         self.batcher.begin_drain()
-        drained = self.batcher.wait_idle(timeout_s)
+        if timeout_s > 0:
+            # the drain budget is enforced by the resilience watchdog's
+            # cancel-and-raise mode (the same deadline machinery the
+            # elastic collective timeout uses): a drain that wedges —
+            # e.g. an in-flight batch stuck in a hung device call, so
+            # the idle condition can never fire — dumps all-thread
+            # stacks and raises in THIS thread instead of hanging
+            # shutdown; the abandoned waiter is harmless (daemon,
+            # wakes into a discarded result)
+            from ..utils.resilience import Watchdog, WatchdogTimeout
+            try:
+                drained = Watchdog(
+                    timeout_s, label="serve drain",
+                    on_timeout="raise").run(self.batcher.wait_idle)
+            except WatchdogTimeout:
+                drained = False
+        else:
+            drained = self.batcher.wait_idle(timeout_s)
         leftover = self.batcher.depth_rows
         if drained:
             Log.info("serve: drained (all accepted requests answered)")

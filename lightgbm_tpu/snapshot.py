@@ -65,13 +65,34 @@ _VOLATILE = {
     "dist_init_retries", "dist_init_timeout_s", "dist_fallback_serial",
 }
 
+# Topology keys, volatile ONLY under elastic training
+# (elastic_enable=true): the recovery ladder's whole premise is that
+# the data-parallel owner-shard reduce makes global histograms
+# shard-count invariant (dp == serial), so a run that started on an
+# 8-wide mesh may legitimately resume on 4, 2, or serially — the
+# topology is where the run executes, not what it trains.  Outside
+# elastic these keys stay signature-relevant (voting's per-shard
+# votes, for one, are topology-dependent).
+_TOPOLOGY_VOLATILE = {"tree_learner", "num_machines", "mesh_shape",
+                      "dp_owner_shard"}
+
 
 def params_signature(params: Dict[str, Any]) -> str:
     """Stable hash of the training-relevant parameter surface."""
-    from .config import canonical_params
+    from .config import _coerce, canonical_params
     cp = canonical_params(params)
+    elastic = bool(_coerce("elastic_enable", bool,
+                           cp.get("elastic_enable", False)))
     for k in _VOLATILE:
         cp.pop(k, None)
+    for k in list(cp):
+        # every elastic_* knob is run control (deadlines, heartbeat
+        # cadence, ladder budgets) — never the trained model
+        if k.startswith("elastic_"):
+            cp.pop(k)
+    if elastic:
+        for k in _TOPOLOGY_VOLATILE:
+            cp.pop(k, None)
     blob = json.dumps(cp, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
@@ -188,7 +209,18 @@ def write_snapshot(booster, prev_booster, cfg, iteration: int,
         text = booster.model_to_string()
     finally:
         booster.trees, booster.tree_weights = trees, weights
-    score = np.asarray(booster._model.score, np.float32)
+    # under elastic multi-process training the model supplies GLOBAL
+    # state (all-process score in global row order + the full-data
+    # fingerprint) so a shrunk — even single-process — relaunch can
+    # resume this snapshot; everywhere else this is exactly the local
+    # score and the train set's own fingerprint
+    fp_override = None
+    state_fn = getattr(booster._model, "snapshot_state", None)
+    if state_fn is not None:
+        score, fp_override = state_fn()
+        score = np.asarray(score, np.float32)
+    else:
+        score = np.asarray(booster._model.score, np.float32)
     buf = io.BytesIO()
     np.savez_compressed(buf, score=score)
     # encode ONCE and write binary: the hashed bytes must be the
@@ -199,7 +231,7 @@ def write_snapshot(booster, prev_booster, cfg, iteration: int,
         "format": _FORMAT,
         "iteration": int(iteration),
         "params_signature": signature,
-        "data_fingerprint": train_set.fingerprint(),
+        "data_fingerprint": fp_override or train_set.fingerprint(),
         "num_data": int(score.shape[0]),
         "num_class": int(score.shape[1]) if score.ndim > 1 else 1,
         "model_file": os.path.basename(base),
@@ -285,8 +317,16 @@ def find_latest_snapshot(output_model: str, signature: str,
     None.  Valid = manifest present and parseable, params signature and
     data fingerprint match, state loads.  Invalid candidates are skipped
     with a warning (an interrupted snapshot write leaves a model file
-    with no manifest — exactly the case this walks past)."""
-    fp = train_set.fingerprint()
+    with no manifest — exactly the case this walks past).
+
+    ``elastic_global_fingerprint`` on the train set (set by
+    ``parallel/elastic.elastic_train`` on multi-process shard datasets)
+    overrides the shard's own fingerprint: elastic multi-process
+    manifests are stamped with the GLOBAL data fingerprint
+    (``GBDTModel.snapshot_state``), which the shard hash would never
+    match."""
+    fp = getattr(train_set, "elastic_global_fingerprint", None) \
+        or train_set.fingerprint()
     for it, path in _list_snapshots(output_model):
         man_path = path + ".manifest.json"
         try:

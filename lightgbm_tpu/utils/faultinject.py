@@ -16,9 +16,13 @@ Spec grammar — comma-separated ``site:hits[:action]`` entries:
   RuntimeError whose message matches the resilience layer's retryable
   patterns), ``kill`` (:class:`InjectedKill`, a BaseException that
   normal ``except Exception`` recovery cannot swallow — simulates the
-  process dying at the site), or ``exit`` (``os._exit(23)``, a REAL
-  death for subprocess tests).  Site ``snapshot_kill`` defaults to
-  ``kill``.
+  process dying at the site), ``exit`` (``os._exit(23)``, a REAL
+  death for subprocess tests), or ``hang`` (the site blocks for
+  ``LGBM_TPU_FAULT_HANG_S`` seconds, default 30 — the wedged-collective
+  / wedged-claim simulation the elastic deadline layer exists to
+  bound; the sleeping thread is abandoned by the watchdog exactly like
+  a real wedge).  Site ``snapshot_kill`` defaults to ``kill``; sites
+  ``collective_hang`` and ``claim_wedge`` default to ``hang``.
 
 Sites wired into the codebase:
 
@@ -55,6 +59,21 @@ Sites wired into the codebase:
                     (``pipeline/continual.py shadow_parity_probe``) —
                     a firing probe is a GATE FAILURE: the candidate is
                     quarantined, the incumbent keeps serving
+``collective_hang`` inside the elastic collective-deadline fetch
+                    (``parallel/elastic.guarded_get`` worker, i.e. the
+                    training loop's one per-iteration host sync) —
+                    default action ``hang``: the fetch wedges and the
+                    deadline must classify + abandon it
+``host_loss``       the elastic per-iteration liveness check
+                    (``parallel/elastic.check_peers``) — a firing site
+                    simulates a peer process's heartbeat going stale
+                    (the kill -9 subprocess tests exercise the real
+                    stale-file detection)
+``claim_wedge``     device claim under elastic
+                    (``models/gbdt.GBDTModel._resolve_mesh``) —
+                    default action ``hang``: the claim wedges and the
+                    bring-up deadline must turn it into a classified
+                    ``ElasticFailure`` instead of a silent hang
 ==================  ========================================================
 
 Also exercisable from ``tools/tpu_watch.py`` probes: export
@@ -73,7 +92,23 @@ KNOWN_SITES = ("device_claim", "collective", "snapshot_write",
                "snapshot_kill", "nan_grads", "serve_batch",
                "serve_reload", "serve_self_check", "continual_append",
                "continual_boost", "continual_publish",
-               "continual_promote", "shadow_probe")
+               "continual_promote", "shadow_probe", "collective_hang",
+               "host_loss", "claim_wedge")
+
+# sites whose realistic failure mode is a WEDGE, not an error
+_HANG_DEFAULT_SITES = ("collective_hang", "claim_wedge")
+
+# how long a firing ``hang`` action blocks: long enough that any sane
+# deadline fires first, short enough that an abandoned daemon thread
+# does not outlive a test session
+HANG_ENV_VAR = "LGBM_TPU_FAULT_HANG_S"
+
+
+def _hang_seconds() -> float:
+    try:
+        return float(os.environ.get(HANG_ENV_VAR, "") or 30.0)
+    except ValueError:
+        return 30.0
 
 
 class InjectedFault(RuntimeError):
@@ -121,12 +156,18 @@ def configure(spec: Optional[str]) -> None:
             raise ValueError(f"bad fault spec entry {entry!r} "
                              "(want site:hits[:action])")
         site, hits = parts[0].strip(), parts[1].strip()
-        action = parts[2].strip() if len(parts) == 3 else (
-            "kill" if parts[0].strip() == "snapshot_kill" else "raise")
+        if len(parts) == 3:
+            action = parts[2].strip()
+        elif site == "snapshot_kill":
+            action = "kill"
+        elif site in _HANG_DEFAULT_SITES:
+            action = "hang"
+        else:
+            action = "raise"
         if site not in KNOWN_SITES:
             raise ValueError(f"unknown fault site {site!r} "
                              f"(known: {', '.join(KNOWN_SITES)})")
-        if action not in ("raise", "kill", "exit"):
+        if action not in ("raise", "kill", "exit", "hang"):
             raise ValueError(f"unknown fault action {action!r}")
         if "-" in hits:
             lo_s, hi_s = hits.split("-", 1)
@@ -167,7 +208,7 @@ def _advance(site: str) -> Tuple[bool, int, str]:
 
 
 def check(site: str) -> None:
-    """Raise/exit if ``site`` fires on this hit; no-op otherwise."""
+    """Raise/exit/hang if ``site`` fires on this hit; no-op otherwise."""
     if not _spec:
         return
     fire, n, action = _advance(site)
@@ -177,6 +218,13 @@ def check(site: str) -> None:
         os._exit(23)
     if action == "kill":
         raise InjectedKill(site, n)
+    if action == "hang":
+        # the wedge simulation: block like a hung collective/claim
+        # would.  Bounded (HANG_ENV_VAR) so an abandoned thread cannot
+        # outlive the test session; any sane deadline fires well before
+        import time
+        time.sleep(_hang_seconds())
+        return
     raise InjectedFault(site, n)
 
 

@@ -363,6 +363,21 @@ class CircuitBreaker:
 # Watchdog: faulthandler stack dumps for wedged blocking calls
 # ---------------------------------------------------------------------------
 
+class WatchdogTimeout(RuntimeError):
+    """A blocking call guarded by :meth:`Watchdog.run` exceeded its
+    deadline.  The message deliberately matches the resilience
+    classifier's retryable patterns (``deadline exceeded``) so a hung
+    collective/claim is retried — or handed to the elastic recovery
+    ladder — like any other transient device failure."""
+
+    def __init__(self, label: str, timeout_s: float):
+        self.label = label
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"deadline exceeded: {label or 'blocking call'} still "
+            f"running after {timeout_s:g}s (abandoned by watchdog)")
+
+
 class Watchdog:
     """Context manager arming periodic ``faulthandler`` stack dumps while
     a blocking device call is in flight::
@@ -375,17 +390,39 @@ class Watchdog:
     round-5 wedge produced NO traceback for 10 hours; this makes the
     hang loud and attributable.  ``timeout_s <= 0`` disables.
 
+    **Cancel-and-raise mode** (``on_timeout="raise"``): :meth:`run`
+    executes the guarded call in a daemon worker thread and, at the
+    deadline, raises :class:`WatchdogTimeout` in the WAITING thread —
+    the hung C call itself cannot be interrupted (a wedged collective
+    blocks in the runtime), so the worker is abandoned and the caller
+    gets a classified, retryable exception instead of a silent hang.
+    The all-thread stack dump and flight-recorder dump fire
+    synchronously at the deadline, so the post-mortem survives the
+    abandonment.  The default (``on_timeout="dump"``) keeps the
+    historical dump-only behavior: :meth:`run` calls the function
+    inline under the context manager and never raises on its own
+    (tests/test_zelastic.py pins this regression contract).
+
     ``faulthandler``'s later-dump timer is process-global: nesting
-    Watchdogs (or combining with pytest's per-test dump) leaves the
-    innermost exit having cancelled the outer timer.  Acceptable for the
-    bring-up call sites this guards — they do not nest.
+    dump-mode Watchdogs (or combining with pytest's per-test dump)
+    leaves the innermost exit having cancelled the outer timer.
+    Acceptable for the bring-up call sites the CONTEXT MANAGER guards —
+    they do not nest.  Raise-mode :meth:`run` deliberately never
+    touches that timer (it dumps synchronously at the deadline
+    instead): its callers — the per-iteration elastic collective
+    deadline above all — would otherwise cancel any ambient hang dump
+    (e.g. the conftest per-test watchdog) on every single fetch.
     """
 
     def __init__(self, timeout_s: float, label: str = "",
-                 file=None) -> None:
+                 file=None, on_timeout: str = "dump") -> None:
+        if on_timeout not in ("dump", "raise"):
+            raise ValueError(
+                f"on_timeout must be 'dump' or 'raise', got {on_timeout!r}")
         self.timeout_s = float(timeout_s)
         self.label = label
         self.file = file
+        self.on_timeout = on_timeout
         self._bb_timer = None
 
     def __enter__(self) -> "Watchdog":
@@ -411,6 +448,59 @@ class Watchdog:
             if self._bb_timer is not None:
                 self._bb_timer.cancel()
                 self._bb_timer = None
+
+    def run(self, fn: Callable, *args, **kwargs):
+        """Call ``fn(*args, **kwargs)`` under this watchdog.
+
+        ``on_timeout="dump"`` (default): inline call inside the context
+        manager — stack dumps at the deadline, no exception, identical
+        to ``with Watchdog(...): fn()``.
+
+        ``on_timeout="raise"``: the call runs in a daemon worker
+        thread; if it has not finished after ``timeout_s`` the waiting
+        thread dumps every thread's stack + the live flight recorders
+        synchronously, raises :class:`WatchdogTimeout`, and the worker
+        is abandoned — it keeps whatever it was wedged on, like a real
+        hung collective, and its eventual result (or exception) is
+        discarded.  ``timeout_s <= 0`` always runs inline (no
+        deadline)."""
+        if self.timeout_s <= 0 or self.on_timeout == "dump":
+            with self:
+                return fn(*args, **kwargs)
+        box: dict = {}
+        done = threading.Event()
+
+        def _worker():
+            try:
+                box["value"] = fn(*args, **kwargs)
+            except BaseException as e:      # noqa: BLE001 — relayed below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_worker, daemon=True,
+                             name=f"watchdog:{self.label or 'call'}")
+        t.start()
+        if not done.wait(self.timeout_s):
+            # deadline: post-mortem NOW (all-thread stacks + every live
+            # blackbox ring), synchronously in this thread — NOT via the
+            # process-global dump_traceback_later timer, which per-call
+            # arm/cancel would silently disable any ambient hang dump
+            # (conftest's per-test watchdog) for raise-mode callers that
+            # run once per training iteration
+            faulthandler.dump_traceback(
+                file=self.file if self.file is not None else sys.stderr,
+                all_threads=True)
+            from ..obs.blackbox import dump_all
+            dump_all(f"watchdog:{self.label}" if self.label
+                     else "watchdog")
+            from .log import Log
+            Log.warning(f"watchdog: {self.label or 'blocking call'} "
+                        f"abandoned after {self.timeout_s:g}s deadline")
+            raise WatchdogTimeout(self.label, self.timeout_s)
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,141 @@
+"""Subprocess worker for the elastic kill -9 matrix
+(tests/test_zelastic.py, the ``continual_worker.py`` mold).
+
+Three modes over ONE deterministic dataset and parameter set:
+
+- ``worker <rank> <machines>`` — one rank of a 2-process
+  ``jax.distributed`` data-parallel elastic run (gloo collectives on
+  CPU, 1 device per process).  Rank 1 SIGKILLs itself mid-iteration
+  (after the snapshot at ``KILL_AFTER_ITER`` landed); rank 0 must
+  detect the loss via the elastic liveness layer (heartbeat staleness
+  or the collective deadline — whichever classifies first), persist
+  the failure, and exit with :data:`SHRINK_RC` carrying a
+  ``shrink.json`` marker (survivors + detection seconds) — the
+  pod-launcher contract of ``ElasticShrinkRequired``.
+- ``resume`` — the relaunched survivor: single process over the FULL
+  data; ``resume=true`` must locate the 2-process run's snapshot (its
+  manifest carries the GLOBAL score + full-data fingerprint) and
+  finish the remaining rounds on the shrunk (serial) topology.
+  Writes ``final.txt`` and prints ``WORKER_DONE``.
+- ``serial`` — the uninterrupted single-process oracle; writes
+  ``serial.txt``.
+
+With ``quant_train=true`` (int32 histograms) ``final.txt`` must be
+BYTE-IDENTICAL to ``serial.txt``; the f32 histogram path is asserted
+to metric-epsilon by the driver instead.
+
+Usage: python elastic_worker.py <outdir> <mode> [rank] [machines]
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+ROUNDS = 10
+KILL_AFTER_ITER = 4      # rank 1 dies right after this iteration's
+#                          callback — one iteration past the snapshot
+SNAPSHOT_FREQ = 2
+SHRINK_RC = 42
+
+
+def _data(n=320, f=6, seed=11):
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, f)
+    y = (x[:, 0] - 0.6 * x[:, 1] + 0.2 * rs.randn(n) > 0) \
+        .astype("float32")
+    return x, y
+
+
+def _params(outdir, quant: bool):
+    return {"objective": "binary", "num_leaves": 8, "max_bin": 31,
+            "min_data_in_leaf": 5, "verbosity": -1,
+            "quant_train": bool(quant),
+            "output_model": os.path.join(outdir, "m.txt"),
+            "snapshot_freq": SNAPSHOT_FREQ, "snapshot_keep": 0,
+            "elastic_enable": True,
+            "elastic_heartbeat_dir": os.path.join(outdir, "hb"),
+            "elastic_heartbeat_interval_s": 0.2,
+            "elastic_heartbeat_timeout_s": 2.0,
+            "elastic_collective_timeout_s": 4.0,
+            "elastic_recover_timeout_s": 60.0}
+
+
+def main():
+    outdir, mode = sys.argv[1], sys.argv[2]
+    quant = os.environ.get("ELASTIC_WORKER_QUANT", "1") != "0"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.utils.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+    x, y = _data()
+    params = _params(outdir, quant)
+
+    if mode == "serial":
+        import lightgbm_tpu as lgb
+        p = {k: v for k, v in params.items()
+             if not k.startswith("elastic_") and k != "snapshot_freq"}
+        bst = lgb.train(p, lgb.Dataset(x, label=y),
+                        num_boost_round=ROUNDS)
+        with open(os.path.join(outdir, "serial.txt"), "w",
+                  encoding="utf-8") as f:
+            f.write(bst.model_to_string().split("parameters:")[0])
+        print("WORKER_DONE serial", flush=True)
+        return
+
+    from lightgbm_tpu.parallel import elastic
+
+    if mode == "resume":
+        bst = elastic.elastic_train(dict(params, tree_learner="serial"),
+                                    x, y, num_boost_round=ROUNDS)
+        with open(os.path.join(outdir, "final.txt"), "w",
+                  encoding="utf-8") as f:
+            f.write(bst.model_to_string().split("parameters:")[0])
+        print(f"WORKER_DONE resume trees={len(bst.trees)}", flush=True)
+        return
+
+    assert mode == "worker"
+    rank, machines = int(sys.argv[3]), sys.argv[4]
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from lightgbm_tpu.parallel import launch
+    entries = [m for m in machines.split(",") if m]
+    launch.init(coordinator_address=entries[0],
+                num_processes=len(entries), process_id=rank)
+
+    last_iter_t = {"t": time.time()}
+
+    def on_iter(env):
+        last_iter_t["t"] = time.time()
+        if rank == 1 and env.iteration + 1 == KILL_AFTER_ITER:
+            # the kill -9: a preempted host vanishes without unwinding
+            print("WORKER_KILLING_SELF", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    p = dict(params, tree_learner="data", num_machines=len(entries))
+    try:
+        bst = elastic.elastic_train(p, x, y, num_boost_round=ROUNDS,
+                                    callbacks=[on_iter])
+    except elastic.ElasticShrinkRequired as e:
+        detect_s = time.time() - last_iter_t["t"]
+        with open(os.path.join(outdir, f"shrink_{rank}.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({"kind": e.kind, "survivors": e.survivors,
+                       "detect_s": round(detect_s, 3),
+                       "rank": rank}, f)
+        print(f"WORKER_SHRINK kind={e.kind} detect_s={detect_s:.2f}",
+              flush=True)
+        # os._exit: the dead peer makes jax.distributed's atexit
+        # shutdown barrier unreachable — exiting through it would hang
+        # this process on the very failure it just classified
+        os._exit(SHRINK_RC)
+    # rank 0 only reaches here if the peer never died (a test bug)
+    print(f"WORKER_DONE unexpected trees={len(bst.trees)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

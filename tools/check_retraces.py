@@ -194,6 +194,23 @@ def run_matrix() -> Dict[str, int]:
             for nl in (31, 63):
                 _train(lgb, x, y, tree_learner="feature", num_leaves=nl)
 
+    # 9. elastic recovery ladder (ISSUE 14): the shrink path rebuilds a
+    #    Booster per rung — full mesh, shrunk mesh, serial.  The
+    #    process-level dp-grower memo (parallel/data_parallel._SHARED)
+    #    + the padded leaf budget must give ONE grower trace per
+    #    TOPOLOGY for a 31/63 sweep (not one per Booster or per
+    #    num_leaves), and the serial rung re-uses scenario 1's trace —
+    #    so a recovery retries rungs for free and the whole ladder
+    #    costs a bounded trace family.  Needs >= 4 devices.
+    if len(_jax.devices()) >= 4:
+        with _Scope("elastic_ladder", measured):
+            for mesh_n in (4, 2):
+                for nl in (31, 63):
+                    _train(lgb, x, y, tree_learner="data",
+                           mesh_shape=[mesh_n], num_leaves=nl)
+            for nl in (31, 63):     # the serial rung: already traced
+                _train(lgb, x, y, num_leaves=nl)
+
     # negative control: the SAME sweep unbucketed must blow the budget
     with _Scope("negative_unbucketed", measured):
         for nl in (31, 40, 63):
@@ -220,12 +237,13 @@ def write_budget(measured: Dict[str, int], path: str = BUDGET) -> None:
 def check(measured: Dict[str, int],
           budget: Dict[str, int]) -> List[str]:
     findings: List[str] = []
-    if not any(k.startswith("dist_leaf_sweep.") for k in measured):
-        # multi-device scenario skipped (a backend was live before
-        # run_lint could arrange the virtual mesh): its pins are not
-        # stale, just unmeasurable here
-        budget = {k: v for k, v in budget.items()
-                  if not k.startswith("dist_leaf_sweep.")}
+    for multidev in ("dist_leaf_sweep.", "elastic_ladder."):
+        if not any(k.startswith(multidev) for k in measured):
+            # multi-device scenario skipped (a backend was live before
+            # run_lint could arrange the virtual mesh): its pins are not
+            # stale, just unmeasurable here
+            budget = {k: v for k, v in budget.items()
+                      if not k.startswith(multidev)}
     for k in sorted(measured):
         if k not in budget:
             findings.append(f"unpinned counter: {k} = {measured[k]} "

@@ -1,0 +1,217 @@
+"""Chaos-injection soak harness for ELASTIC TRAINING (the
+``tools/soak_serve.py`` analog for the training side).
+
+Runs one boosting job under the elastic recovery ladder
+(``lightgbm_tpu/parallel/elastic.elastic_train``) while
+``utils/faultinject`` windows wedge its collectives
+(``collective_hang``), wedge its device claim (``claim_wedge``) and
+kill a simulated peer (``host_loss``) mid-run, then checks the
+invariants the elastic layer promises (docs/Fault-Tolerance.md
+"Elastic training"):
+
+- **Zero hangs**: every collective is bounded by
+  ``elastic_collective_timeout_s`` — the injected wedges sleep far
+  longer than the deadline, so the run only completes inside the
+  wall-clock budget if the deadline actually fired and classified
+  every one of them.
+- **Shrink-to-survive**: the run completes WITH at least one mesh
+  shrink (full mesh -> shrunk mesh -> serial as the chaos demands),
+  resuming each rung from the newest COMPLETE snapshot — no lost
+  iterations beyond the snapshot gap, counted via the final model's
+  tree count.
+- **Determinism**: the final model passes the metric-parity harness
+  against an uninterrupted SERIAL run over the same data — bitwise
+  tree text on the int32 quantized-histogram path (the default here),
+  metric-epsilon on f32.
+- **Observability**: ``elastic.*`` recovery metrics are present
+  (failures by kind, shrinks, recoveries, mesh gauge), the
+  per-failure JSONL event log exists next to the model, and the
+  flight recorder (``telemetry_blackbox``) dumped on the classified
+  failures.
+
+Run standalone (prints one JSON report, exit 1 on violations)::
+
+    python tools/soak_train.py rounds=16 mesh=4 chaos=1
+
+Importable: ``run_soak_train(...)`` returns the report dict —
+``tests/test_zelastic.py`` runs a short deterministic soak in tier-1.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+N_FEAT = 6
+
+
+def _data(n_rows: int, seed: int = 0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n_rows, N_FEAT)
+    y = (x[:, 0] - 0.7 * x[:, 1] + 0.25 * rs.randn(n_rows) > 0) \
+        .astype("float32")
+    return x, y
+
+
+def run_soak_train(rounds: int = 12, n_rows: int = 400, mesh: int = 4,
+                   seed: int = 0, chaos: bool = True,
+                   chaos_spec: Optional[str] = None,
+                   quant: bool = True, workdir: Optional[str] = None,
+                   hang_s: float = 6.0,
+                   collective_timeout_s: float = 1.0,
+                   budget_s: float = 300.0,
+                   params: Optional[Dict] = None) -> Dict:
+    """One elastic-training soak; returns the report dict (module
+    docstring).  ``chaos=False`` is the control arm: same config, no
+    faults — must complete with zero shrinks and the same final model.
+    """
+    import tempfile
+
+    from lightgbm_tpu import Dataset, train as engine_train
+    from lightgbm_tpu.metrics import _auc
+    from lightgbm_tpu.parallel import elastic
+    from lightgbm_tpu.utils import faultinject
+
+    workdir = workdir or tempfile.mkdtemp(prefix="lgbm_soak_train_")
+    os.makedirs(workdir, exist_ok=True)
+    out_model = os.path.join(workdir, "soak_model.txt")
+    x, y = _data(n_rows, seed)
+
+    p = {"objective": "binary", "num_leaves": 8, "max_bin": 31,
+         "min_data_in_leaf": 5, "verbosity": -1,
+         "tree_learner": "data", "mesh_shape": [int(mesh)],
+         "quant_train": bool(quant),
+         "output_model": out_model,
+         "snapshot_freq": 2, "snapshot_keep": 0,
+         "elastic_enable": True,
+         "elastic_collective_timeout_s": float(collective_timeout_s),
+         "elastic_retries": 1,
+         "elastic_recover_timeout_s": float(budget_s),
+         "dist_init_timeout_s": float(collective_timeout_s),
+         "dist_init_retries": 0,
+         "telemetry_blackbox": True}
+    p.update(params or {})
+
+    # uninterrupted SERIAL oracle over the same data — the parity
+    # anchor the shrunk/ recovered run must reproduce
+    ref_params = {k: v for k, v in p.items()
+                  if not k.startswith(("elastic_", "dist_init",
+                                       "telemetry", "snapshot",
+                                       "mesh_shape", "output_model"))}
+    ref_params["tree_learner"] = "serial"
+    ref = engine_train(dict(ref_params), Dataset(x, label=y),
+                       num_boost_round=rounds)
+
+    violations = []
+    spec = chaos_spec or ("collective_hang:4,claim_wedge:2,host_loss:8"
+                          if chaos else None)
+    prev_hang = os.environ.get(faultinject.HANG_ENV_VAR)
+    os.environ[faultinject.HANG_ENV_VAR] = str(hang_s)
+    elastic.reset_metrics()
+    t0 = time.monotonic()
+    try:
+        faultinject.configure(spec)
+        bst = elastic.elastic_train(dict(p), x, y,
+                                    num_boost_round=rounds)
+    finally:
+        faultinject.clear()
+        if prev_hang is None:
+            os.environ.pop(faultinject.HANG_ENV_VAR, None)
+        else:
+            os.environ[faultinject.HANG_ENV_VAR] = prev_hang
+    wall_s = time.monotonic() - t0
+    report = dict(bst.elastic_report)
+    metrics = elastic.metrics_snapshot()
+
+    # -- invariants --------------------------------------------------------
+    if wall_s > budget_s:
+        violations.append(
+            f"run exceeded its wall budget ({wall_s:.1f}s > {budget_s}s):"
+            " a collective was NOT bounded by the deadline")
+    n_trees = len(bst.trees)
+    if n_trees != rounds:
+        violations.append(
+            f"lost iterations: {n_trees} trees != {rounds} requested "
+            "(recovery must lose nothing beyond the snapshot gap, which "
+            "is retrained on resume)")
+    trees_of = (lambda b:
+                b.model_to_string().split("parameters:")[0]
+                .split("feature_infos")[1])
+    if quant:
+        if trees_of(bst) != trees_of(ref):
+            violations.append(
+                "final model is not bitwise-identical to the "
+                "uninterrupted serial run (int32 quantized path)")
+    auc_ref = _auc(y, ref.predict(x, raw_score=True), None)
+    auc_got = _auc(y, bst.predict(x, raw_score=True), None)
+    if abs(float(auc_ref) - float(auc_got)) > 1e-6:
+        violations.append(
+            f"metric parity failed: soak auc {auc_got:.6f} vs "
+            f"serial {auc_ref:.6f}")
+    if chaos:
+        if report.get("shrinks", 0) < 1:
+            violations.append("chaos run finished without a mesh shrink")
+        if report.get("recoveries", 0) < 1:
+            violations.append("no automatic recovery recorded")
+        kinds = {f["kind"] for f in report.get("failures", ())}
+        if not kinds:
+            violations.append("no classified failures recorded")
+        if not any(k.startswith("elastic.failures")
+                   for k in metrics):
+            violations.append("elastic.failures metrics missing")
+        if "elastic.shrinks" not in metrics:
+            violations.append("elastic.shrinks metric missing")
+        if not os.path.exists(out_model + ".elastic.jsonl"):
+            violations.append("elastic failure event log missing")
+        bb = glob.glob(os.path.join(workdir, "*.blackbox.jsonl*"))
+        if not bb:
+            violations.append("no flight-recorder (blackbox) dump found")
+    else:
+        if report.get("shrinks", 0) != 0:
+            violations.append("control run shrank without chaos")
+
+    return {"violations": violations, "wall_s": round(wall_s, 2),
+            "rounds": rounds, "n_trees": n_trees,
+            "report": report,
+            "auc": round(float(auc_got), 6),
+            "elastic_metrics": {k: v.get("value")
+                                for k, v in metrics.items()
+                                if v.get("type") != "histogram"},
+            "workdir": workdir}
+
+
+def main(argv) -> int:
+    kv = dict(a.split("=", 1) for a in argv if "=" in a)
+    # force CPU + a virtual multi-device topology the supported way
+    # (the axon sitecustomize freezes jax_platforms at interpreter
+    # start; same pattern as bench.py / tools/check_retraces.py)
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rep = run_soak_train(
+        rounds=int(kv.get("rounds", 12)),
+        n_rows=int(kv.get("rows", 400)),
+        mesh=int(kv.get("mesh", 4)),
+        chaos=kv.get("chaos", "1") not in ("0", "false"),
+        quant=kv.get("quant", "1") not in ("0", "false"),
+        hang_s=float(kv.get("hang_s", 6.0)),
+        budget_s=float(kv.get("budget_s", 300.0)))
+    print(json.dumps(rep, indent=1, sort_keys=True))
+    return 1 if rep["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -186,6 +186,10 @@ def main() -> None:
             # relay isn't hammered
             tail = out.strip().splitlines()[-1] if out.strip() else "(empty)"
             probe_span.args["outcome"] = f"exited rc={probe.returncode}"
+            # machine-readable claim-loss reason (ISSUE 14 satellite,
+            # mirrored by bench.py's claim classification): a probe that
+            # EXITS failed to claim; one that never returns is a wedge
+            probe_span.args["reason"] = "no_claim"
             probe_span.end()
             log(f"probe exited rc={probe.returncode} without a grant "
                 f"({tail!r}); respawning in {retry_backoff}s")
@@ -197,7 +201,11 @@ def main() -> None:
         elif int(time.time() - t_probe) % 600 < POLL_S:
             log(f"still waiting on claim ({time.time() - t_probe:.0f}s; "
                 "orphan parked, tunnel presumed wedged)")
-    log("deadline reached; probe orphan left parked; exiting")
+    probe_span.args["outcome"] = "deadline"
+    probe_span.args["reason"] = "wedge"
+    probe_span.end()
+    log("deadline reached; probe orphan left parked; exiting "
+        "(claim-loss reason: wedge)")
 
 
 if __name__ == "__main__":
