@@ -507,11 +507,15 @@ def child_extras() -> None:
     # quantized-training histogram sweep (ISSUE 13, ops/quantize.py):
     # f32 vs int8/int16 packed accumulands through the SHIPPED
     # contraction across split_batch slot widths K in {16,32,64}
-    # (tools/bench_hist.run_quant_bench), folded into extras as
-    # hist_quant_*.  The gated key is hist_hbm_bytes_per_iter: the
-    # static ledger's histogram HBM bytes for ONE canonical 255-leaf
-    # K=16 iteration under quant_bits=8 — lower-better, the ledger-
-    # proven cut this PR exists for (tools/perf_budget.txt pin)
+    # (tools/bench_hist.run_quant_bench — ms/pass AND ms/leaf-slot per
+    # width, plus the autotuner's chosen (K, block_rows) as
+    # provenance), folded into extras as hist_quant_*.  Gated keys
+    # (tools/perf_budget.txt): hist_hbm_bytes_per_iter — the static
+    # ledger's histogram HBM bytes for ONE canonical 255-leaf K=16
+    # iteration under quant_bits=8 (lower-better, the ledger-proven
+    # cut of ISSUE 13) — and hist_ms_per_pass / hist_ms_per_leaf_wide
+    # — the measured shipped-shape pass cost and the best wide-width
+    # per-leaf cost (the MXU-widening win of ISSUE 15)
     try:
         sys.path.insert(0, os.path.join(_DIR, "tools"))
         import bench_hist
@@ -527,12 +531,20 @@ def child_extras() -> None:
             n, N_FEAT, PRIMARY_MAX_BIN, split_batch=16)
         site_q8 = {s.site: s for s in led_q8.sites()}
         site_f32 = {s.site: s for s in led_f32.sites()}
+        wide = [v for k, v in qp.items()
+                if k.startswith("qoff_k") and k.endswith("_ms_per_leaf")
+                and not k.startswith("qoff_k16")]
         _record_point(
             "hist", cpu=cpu,
             hbm_bytes_per_iter=site_q8["hist"].hbm_bytes * steps
             + site_q8["hist_root"].hbm_bytes,
             hbm_bytes_per_iter_f32=site_f32["hist"].hbm_bytes * steps
-            + site_f32["hist_root"].hbm_bytes)
+            + site_f32["hist_root"].hbm_bytes,
+            ms_per_pass=qp.get("qoff_k16_ms_per_pass"),
+            ms_per_leaf_k16=qp.get("qoff_k16_ms_per_leaf"),
+            ms_per_leaf_wide=min(wide) if wide else None,
+            tuned_k=qp.get("tuned_k"),
+            tuned_block_rows=qp.get("tuned_block_rows"))
     except Exception as e:
         _record_point("hist_quant", error=f"{type(e).__name__}: {e}"[:200])
 

@@ -229,6 +229,27 @@ _PARAMS: Dict[str, tuple] = {
     # different (still best-first) growth order.  0 = auto: 1 below 64
     # leaves, then 8.
     "split_batch": (int, 0, []),
+    # on-device (K, block_rows) autotuner for the histogram contraction
+    # (ops/hist_tune.py; docs/Contraction-Width.md): "on" runs a
+    # one-shot measured sweep over the shipped split_batch widths and a
+    # block_rows neighborhood at FIRST fit per (platform, shape
+    # bucket), persists the choice next to the persistent compile
+    # cache, and applies it ONLY when split_batch=0 (auto; an explicit
+    # width is the user's choice and skips the sweep entirely), with
+    # the paired block_rows filling rows_per_block=0.  The tuned K
+    # changes the (equally valid) growth order, so "on" trades
+    # cross-platform model determinism for measured throughput; "off"
+    # (default) reproduces today's exact shapes, traces and models
+    "hist_tune": (str, "off", []),
+    # strict (split_batch=1) grower: build the per-split smaller-child
+    # histogram through the batched path's slot mechanism (one [N]
+    # int32 slot vector as the scan operand) instead of materializing
+    # a fresh masked [N, 3] vals temp per split.  BYTE-IDENTICAL
+    # models by construction (the 0/1 multiply happens inside the
+    # row-block scan on the same values; pinned by
+    # tests/test_hist_width.py) — false restores the serialized
+    # masked-operand baseline for A/B
+    "hist_overlap": (bool, True, []),
     # ---- compile cache / trace buckets ----
     # compile-time management (ROADMAP item 4; docs/Compile-Cache.md)
     # persistent XLA compilation cache across processes (train -> serve
@@ -246,7 +267,8 @@ _PARAMS: Dict[str, tuple] = {
     # grower's leaf budget pads to a pow2 bucket (num_leaves 31/40/63
     # share ONE L=64 trace with bit-identical trees — the while_loop
     # exits on the actual budget), explicit split_batch snaps to the
-    # shipped {1, 8, 16} widths, and DENSE validation sets row-bucket
+    # shipped {1, 8, 16, 32, 64} widths (fitted under the leaf
+    # budget), and DENSE validation sets row-bucket
     # so early stopping over differently-sized valid sets stops
     # re-tracing (sparse-binned valid sets keep exact shapes).
     # false = exact per-shape traces (A/B escape hatch);
@@ -700,6 +722,9 @@ class Config:
             raise ValueError(
                 f"quant_round={self.quant_round!r} must be one of: "
                 "stochastic, nearest")
+        if self.hist_tune not in ("off", "on"):
+            raise ValueError(
+                f"hist_tune={self.hist_tune!r} must be one of: off, on")
         if self.finite_check_policy not in ("raise", "skip_iter", "clamp"):
             raise ValueError(
                 f"finite_check_policy={self.finite_check_policy!r} must be "

@@ -180,6 +180,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                 gain_scale=None,
                 extra_trees: bool = False, extra_seed: int = 6,
                 split_batch: int = 1,
+                hist_overlap: bool = False,
                 mono=None, mono_penalty: float = 0.0,
                 interaction_groups=None,
                 bynode_frac: float = 1.0, bynode_seed: int = 0,
@@ -296,7 +297,25 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
       ceiling ~K× while amortizing the one-hot generation — per-split cost
       drops toward 1/K.  Trees differ slightly from strict leaf-wise
       growth (between LightGBM's leaf-wise and XGBoost's depth-wise);
-      K=1 keeps exact reference semantics and is the default.
+      K=1 keeps exact reference semantics and is the default.  Widths
+      are snapped into ``utils/shapes.SPLIT_BATCH_SET`` (and fitted
+      under the leaf budget) by the driver; the wide widths (32/64)
+      lane-pad their C=3K channel axis to MXU 128-multiples inside the
+      contraction (ops/histogram.py) — exact zeros, sliced off,
+      excluded from MFU accounting (obs/flops.py ``hist_pad``).
+    - hist_overlap: route the STRICT (K=1) grower's masked smaller-child
+      pass through the same per-row slot mechanism the batched grower
+      uses (``slot = 0 if in_child else -1``, num_slots=1) instead of
+      materializing a fresh ``vals * mask`` [N, 3] scan operand per
+      split.  The slot one-hot multiplies the identical 0/1 factors
+      inside the row-block scan, so the histogram — and the trained
+      model — is BYTE-IDENTICAL to the serialized masked baseline
+      (tests/test_hist_width.py pins it), while the per-split scan
+      operand shrinks to one [N] int32 slot vector and the strict path
+      shares the contraction form (and the autotuner's block_rows
+      choice, ops/hist_tune.py) with the batched super-step.
+      Sparse-binned data keeps the masked form (its per-slot total
+      reduction has a different summation order).
     """
     L_req = int(num_leaves)
     L = int(padded_leaves) if padded_leaves and int(padded_leaves) > L_req \
@@ -389,6 +408,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
             in_child = leaf_of_row == child_id
 
             def full_pass(_):
+                if hist_overlap \
+                        and not isinstance(binned_view, _spd.SparseBinned):
+                    # overlap path: the mask rides as a 1-slot id so the
+                    # 0/1 multiply happens INSIDE the row-block scan —
+                    # byte-identical products, but the per-split scan
+                    # operand is one [N] int32 vector instead of a
+                    # fresh [N, 3] masked temp (see make_grower doc)
+                    sl = jnp.where(in_child, jnp.int32(0), jnp.int32(-1))
+                    return _hist(binned_view, vals, slot=sl, nslots=1,
+                                 scales=scales)
                 mask = in_child.astype(vals.dtype)[:, None]
                 return _hist(binned_view, vals * mask, scales=scales)
 
@@ -1222,6 +1251,7 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                sum_reduce, scale_reduce, row_offset)):
         key = _grower_key(dict(
             L=L, B=B, K=K, padded=padded, params=params,
+            hist_overlap=hist_overlap,
             max_depth=max_depth, block_rows=block_rows, subtract=subtract,
             gather=gather, min_gather_rows=min_gather_rows, efb=efb,
             gain_scale=gain_scale, extra_trees=extra_trees,

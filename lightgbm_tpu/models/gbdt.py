@@ -452,23 +452,99 @@ class GBDTModel:
                 "trees differ slightly from strict leaf-wise order — set "
                 "split_batch=1 for exact reference growth)")
 
+        # on-device contraction autotuner (ops/hist_tune.py): under
+        # hist_tune=on the FIRST fit per (platform, shape bucket)
+        # sweeps the eligible (K, block_rows) grid by measured ms per
+        # leaf slot and persists the winner next to the compile cache;
+        # later fits — including other processes — reuse it (zero
+        # re-tune, zero re-compile).  The tuner engages ONLY when
+        # split_batch is on auto (an explicit width is the user's
+        # choice, and applying the winner's paired block_rows to a
+        # different K would both mis-tune and re-partition the f32
+        # scan against the explicit-width byte pins); the tuned
+        # block_rows fills rows_per_block=0.  Budgets that admit only
+        # strict growth (num_leaves <= 8: no set width fits) have
+        # nothing to tune and skip the sweep entirely.
+        self._block_rows = config.rows_per_block
+        self._hist_tuned = None
+        if getattr(config, "hist_tune", "off") == "on" and sb < 1 \
+                and learner == "masked" and dist != "voting" \
+                and not self._sparse:
+            from ..utils.shapes import SPLIT_BATCH_SET as _SBS
+            from ..utils.shapes import fit_split_batch
+            kmax = fit_split_batch(_SBS[-1], config.num_leaves)
+            if kmax > 1:
+                try:
+                    from ..ops.hist_tune import ensure as _tune_ensure
+                    # the contraction's column/bin axes: the binned
+                    # matrix as built (EFB bundles -> group columns at
+                    # group-bin width; dense otherwise)
+                    t_cols = int(self.binned_dev.shape[1])
+                    t_bins = (int(self.efb_dev.group_bins)
+                              if self._use_efb else self.max_bin)
+                    n_global = (int(self._global_counts.sum())
+                                if self._global_counts is not None
+                                else self.num_data)
+                    rec = self._hist_tuned = _tune_ensure(
+                        n_global, t_cols, t_bins,
+                        itemsize=(self._quant.itemsize
+                                  if self._quant is not None else 4),
+                        kmax=kmax, config=config)
+                    self._split_batch = rec["k"]
+                    if config.rows_per_block <= 0:
+                        self._block_rows = int(rec["block_rows"])
+                    from ..utils.log import Log
+                    Log.info(
+                        f"hist_tune: measured choice K={rec['k']} "
+                        f"block_rows={rec['block_rows']} "
+                        f"({rec['ms_per_leaf']} ms/leaf-slot at "
+                        f"{rec.get('sample_rows')} sampled rows)")
+                except Exception as e:        # tuner is best-effort
+                    from ..utils.log import Log
+                    Log.warning(
+                        f"hist_tune failed ({type(e).__name__}: {e}); "
+                        "keeping untuned shapes")
+
         # trace-relevant static dims are bucketed (utils/shapes.py) so a
         # config sweep stays inside a bounded trace family; pinned by
         # tools/check_retraces.py.  trace_buckets=false restores exact
         # per-shape traces (A/B + escape hatch).
         from ..utils.shapes import (SPLIT_BATCH_SET, bucket_leaves,
-                                    snap_split_batch)
+                                    fit_split_batch, snap_split_batch)
         self._trace_buckets = bool(getattr(config, "trace_buckets", True))
-        if self._trace_buckets and self._split_batch > 1 \
-                and self._split_batch not in SPLIT_BATCH_SET:
-            snapped = snap_split_batch(self._split_batch)
-            from ..utils.log import Log
-            Log.info(
-                f"split_batch={self._split_batch} snapped to the shipped "
-                f"super-step width {snapped} (trace_buckets=true pins the "
-                f"trace family to K in {SPLIT_BATCH_SET}; set "
-                "trace_buckets=false to keep an off-set width)")
-            self._split_batch = snapped
+        if self._trace_buckets and self._split_batch > 1:
+            snapped = self._split_batch
+            if snapped not in SPLIT_BATCH_SET:
+                snapped = snap_split_batch(snapped)
+            if snapped > 16:
+                # the WIDE widths also fit under the leaf budget by
+                # stepping DOWN the set (31 leaves at K=32 runs K=16)
+                # so no off-set width ever opens a private trace
+                # family; the shipped widths <= 16 keep their historic
+                # clamp (grower.py K = min(K, num_leaves-1)) for
+                # byte-identity with existing models
+                snapped = fit_split_batch(snapped, config.num_leaves)
+            if snapped != self._split_batch:
+                from ..utils.log import Log
+                Log.info(
+                    f"split_batch={self._split_batch} snapped to the "
+                    f"shipped super-step width {snapped} "
+                    f"(trace_buckets=true pins the trace family to K in "
+                    f"{SPLIT_BATCH_SET}, fitted under num_leaves="
+                    f"{config.num_leaves}; set trace_buckets=false to "
+                    "keep an off-set width)")
+                self._split_batch = snapped
+        # effective strict-overlap flag (grower.py hist_overlap):
+        # masked growers only — voting keeps the masked pass (its
+        # top-k vote is per histogram call either way), sparse-binned
+        # data keeps its own total-reduction order, and the
+        # partitioned learner has no slot path.  Threaded through the
+        # serial, fused-chunk, data- and feature-parallel builders;
+        # the flop ledger accounts the 1-slot mask as the masked pass
+        # it is byte-identical to (obs/flops.hist_flops_bytes).
+        self._hist_overlap = (bool(getattr(config, "hist_overlap", True))
+                              and learner == "masked"
+                              and dist != "voting" and not self._sparse)
         # leaf-budget bucketing: every one-program (masked) grower takes
         # a traced budget — serial, data, and (since the ROADMAP item-1
         # remainder closed) the voting/feature growers too; only the
@@ -513,9 +589,10 @@ class GBDTModel:
             self.grower = make_dp_grower(
                 self._mesh, num_leaves=config.num_leaves,
                 num_bins=self.max_bin, params=self.split_params,
-                max_depth=config.max_depth, block_rows=config.rows_per_block,
+                max_depth=config.max_depth, block_rows=self._block_rows,
                 efb=self.efb_dev if self._use_efb else None,
                 split_batch=self._split_batch,
+                hist_overlap=self._hist_overlap,
                 mono=self._mono if mono_masked_ok else None,
                 mono_penalty=config.monotone_penalty,
                 sparse=self._sparse,
@@ -530,7 +607,7 @@ class GBDTModel:
                 self._mesh, num_leaves=config.num_leaves,
                 num_bins=self.max_bin, params=self.split_params,
                 top_k=config.top_k, max_depth=config.max_depth,
-                block_rows=config.rows_per_block,
+                block_rows=self._block_rows,
                 padded_leaves=self._leaf_pad, quant=self._quant)
         elif dist == "feature":
             from ..parallel.feature_parallel import make_fp_grower
@@ -538,8 +615,9 @@ class GBDTModel:
                 self._mesh, num_features=self.num_features + self._feat_pad,
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
-                block_rows=config.rows_per_block,
+                block_rows=self._block_rows,
                 split_batch=self._split_batch,
+                hist_overlap=self._hist_overlap,
                 padded_leaves=self._leaf_pad, quant=self._quant)
         elif hist_reduce is None and learner == "partitioned":
             # single-chip performance learner (grower_partitioned.py):
@@ -548,7 +626,7 @@ class GBDTModel:
             self.grower = PartitionedGrower(
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
-                block_rows=config.rows_per_block, mono=mono,
+                block_rows=self._block_rows, mono=mono,
                 mono_method=config.monotone_constraints_method,
                 mono_penalty=config.monotone_penalty,
                 interaction_groups=inter,
@@ -577,12 +655,13 @@ class GBDTModel:
             self.grower = make_grower(
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
-                block_rows=config.rows_per_block, hist_reduce=hist_reduce,
+                block_rows=self._block_rows, hist_reduce=hist_reduce,
                 quant=self._quant,
                 efb=self.efb_dev if self._use_efb else None,
                 gain_scale=contri, extra_trees=self._extra_trees,
                 extra_seed=config.extra_seed,
                 split_batch=self._split_batch,
+                hist_overlap=self._hist_overlap,
                 mono=self._mono if mono_masked_ok else None,
                 mono_penalty=config.monotone_penalty,
                 interaction_groups=inter,
@@ -1299,11 +1378,12 @@ class GBDTModel:
             grow = make_grower(
                 num_leaves=cfg.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=cfg.max_depth,
-                block_rows=cfg.rows_per_block,
+                block_rows=self._block_rows,
                 efb=self.efb_dev if self._use_efb else None,
                 gain_scale=self._feature_contri,
                 extra_trees=self._extra_trees, extra_seed=cfg.extra_seed,
                 split_batch=self._split_batch,
+                hist_overlap=self._hist_overlap,
                 mono=self._mono if self._learner_kind == "masked" else None,
                 mono_penalty=cfg.monotone_penalty,
                 interaction_groups=self._inter,

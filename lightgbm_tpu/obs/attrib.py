@@ -134,13 +134,20 @@ def perf_summary(snap: Dict[str, dict],
             continue
         labels = _labels(m.group(2))
         ph = labels.get("phase", "other")
-        d = phases.setdefault(ph, {"flops": 0.0, "hbm_bytes": 0.0})
         kind = "flops" if m.group(1) == "total" else "hbm_bytes"
-        d[kind] += float(rec.get("value", 0.0))
         site = labels.get("site")
         if site:
             ds = sites.setdefault(site, {"flops": 0.0, "hbm_bytes": 0.0})
             ds[kind] += float(rec.get("value", 0.0))
+        if ph == "pad":
+            # MXU lane-pad MACs (obs/flops.hist_pad_flops_bytes): real
+            # hardware cycles but not useful work — surfaced per-site
+            # (perf.hist_pad.*) yet EXCLUDED from phase and total
+            # aggregation so perf.*.mfu never counts channel padding
+            # as achieved FLOPs
+            continue
+        d = phases.setdefault(ph, {"flops": 0.0, "hbm_bytes": 0.0})
+        d[kind] += float(rec.get("value", 0.0))
     if not phases:
         return {}
     out: Dict[str, object] = {}

@@ -71,7 +71,8 @@ def padded_bins(num_bins: int) -> int:
 def hist_flops_bytes(n_rows: int, n_cols: int, num_bins: int,
                      channels: int = 3,
                      binned_itemsize: int = 1,
-                     vals_itemsize: int = 4) -> Tuple[int, int]:
+                     vals_itemsize: int = 4,
+                     slotted: bool = None) -> Tuple[int, int]:
     """One full-N one-hot-contraction histogram pass over ``n_cols``
     binned columns (features, or EFB groups): ``hist[c, f*Bp] +=
     vals[c, n] @ onehot[n, f*Bp]`` — 2 FLOPs per MAC.  ``channels`` is
@@ -80,17 +81,58 @@ def hist_flops_bytes(n_rows: int, n_cols: int, num_bins: int,
     (grad, hess, weight) vals read AT THEIR STORED WIDTH
     (``vals_itemsize``: 4 for f32, 1/2 for the int8/int16 quantized
     packing — the per-dtype accounting the quant_train acceptance
-    instrument reads) + the [N] slot vector when the per-slot expansion
-    is active + histogram write (f32 and int32 are both 4-byte lanes);
-    the one-hot is generated in-registers (measured fused,
-    ops/histogram.py)."""
+    instrument reads) + the [N] int32 slot vector when the TRUE
+    multi-slot expansion is active (``slotted``: num_slots > 1, the
+    kernel passes it explicitly; defaults to ``channels > 3``) +
+    histogram write (f32 and int32 are both 4-byte lanes); the one-hot
+    is generated in-registers (measured fused, ops/histogram.py).
+
+    Accounting convention for the strict hist_overlap path: its 1-slot
+    mask is the in-graph ENCODING of the masked pass it is
+    byte-identical to — like the ``vals * mask`` temp it replaces
+    (which this model never counted under the perfect-fusion rule),
+    the [N] mask carries no operand bytes here.  Only a real K-way
+    slot expansion (num_slots > 1) adds the slot read, which keeps the
+    quantized-training byte-cut instrument (docs/Quantized-Training.md
+    ≥2x pin) calibrated identically across overlap on/off.
+
+    ``channels`` is the USEFUL (logical) width: the MXU lane padding
+    wide widths take (C = 3K > 48 buckets to 128 multiples,
+    utils/shapes.bucket_channels) is NOT useful work, so its MACs are
+    excluded here and accounted separately by
+    :func:`hist_pad_flops_bytes` under the MFU-excluded ``pad`` phase
+    — MFU from this site stays an honest useful-work fraction.  The
+    histogram WRITE does cross HBM at the padded width (the padded
+    accumulator is materialized before the in-kernel slice), so the
+    write term uses the padded channel count."""
+    from ..utils.shapes import bucket_channels
+    if slotted is None:
+        slotted = int(channels) > 3
     bp = padded_bins(num_bins)
     flops = 2 * int(channels) * int(n_rows) * int(n_cols) * bp
     hbm = (int(n_rows) * int(n_cols) * int(binned_itemsize)
            + int(n_rows) * 3 * int(vals_itemsize)
-           + (int(n_rows) * 4 if channels > 3 else 0)
-           + int(channels) * int(n_cols) * bp * 4)
+           + (int(n_rows) * 4 if slotted else 0)
+           + bucket_channels(int(channels)) * int(n_cols) * bp * 4)
     return flops, hbm
+
+
+def hist_pad_flops_bytes(n_rows: int, n_cols: int, num_bins: int,
+                         channels: int = 3) -> Tuple[int, int]:
+    """The lane-pad MACs of one wide histogram pass: the hardware
+    multiplies the padded ``bucket_channels(C) - C`` zero columns too
+    (ops/histogram.py), but they produce no useful result — recorded
+    as the ``hist_pad`` site under ``phase="pad"``, which
+    ``obs/attrib.perf_summary`` reports per-site but EXCLUDES from
+    phase/total aggregation so MFU never counts padding as achieved
+    work.  Zero bytes: the pad's operand columns are generated
+    in-registers and its write share is already in the ``hist`` site's
+    padded write term."""
+    from ..utils.shapes import bucket_channels
+    c = int(channels)
+    pad = bucket_channels(c) - c
+    bp = padded_bins(num_bins)
+    return 2 * pad * int(n_rows) * int(n_cols) * bp, 0
 
 
 # elementwise ops per (direction, feature, bin) cell of the numerical
@@ -318,9 +360,15 @@ class FlopLedger:
         ``vals_itemsize``/``quant``: quantized training (quant_train)
         — the histogram passes read int8/int16 accumulands instead of
         f32, and the quantize/dequant sites appear so ``perf.hist.*``
-        intensity/bound keys show the bound actually moving.  Sites:
+        intensity/bound keys show the bound actually moving.  (The
+        strict hist_overlap path's 1-slot mask is accounted as the
+        masked pass it is byte-identical to — see
+        :func:`hist_flops_bytes`.)  Sites:
 
         - ``hist``       smaller-child contraction, C=3K, per step
+        - ``hist_pad``   MXU lane-pad MACs of the wide contraction
+                         (C=3K > 48 buckets to 128 multiples), per
+                         step — phase="pad", excluded from MFU
         - ``hist_root``  root contraction, C=3, per class per iter
         - ``split_scan`` 2K candidate leaves per step
         - ``split_root`` root scan, per class per iteration
@@ -336,11 +384,16 @@ class FlopLedger:
         led = cls()
         f, b = hist_flops_bytes(n_rows, hc, hb, channels=3 * k,
                                 binned_itemsize=binned_itemsize,
-                                vals_itemsize=vals_itemsize)
+                                vals_itemsize=vals_itemsize,
+                                slotted=k > 1)
         led.add("hist", "grow", f, b, "step")
+        f, b = hist_pad_flops_bytes(n_rows, hc, hb, channels=3 * k)
+        if f:
+            led.add("hist_pad", "pad", f, b, "step")
         f, b = hist_flops_bytes(n_rows, hc, hb, channels=3,
                                 binned_itemsize=binned_itemsize,
-                                vals_itemsize=vals_itemsize)
+                                vals_itemsize=vals_itemsize,
+                                slotted=False)
         led.add("hist_root", "grow", f * nc, b * nc, "iter")
         f, b = split_scan_flops_bytes(n_feat, num_bins, n_leaves=2 * k)
         led.add("split_scan", "grow", f, b, "step")

@@ -42,14 +42,37 @@ HIST_ONEHOT_BUDGET = 64 * 1024 * 1024
 
 
 def hist_block_rows(num_features: int, padded_bins: int,
-                    itemsize: int = 4) -> int:
+                    itemsize: int = 4, channels: int = 3) -> int:
     """Row-block size bounded by the one-hot intermediate's byte
     budget.  ``itemsize`` is the accumuland (vals) element width — the
     one-hot operand is generated at the SAME width so the dot's operand
     dtypes match, so int8-packed passes (quant_train, ops/quantize.py)
-    get proportionally larger blocks than the f32 default."""
-    blk = HIST_ONEHOT_BUDGET \
-        // max(num_features * padded_bins * int(itemsize), 1)
+    get proportionally larger blocks than the f32 default.
+
+    ``channels``: the slot-expanded (and lane-padded) accumuland width
+    C = cv·K of the multi-leaf contraction.  Past the shipped ceiling
+    (C = 48, K = 16) the budget must account the C·K expansion the old
+    feature-only formula ignored — at K=64 on a wide dataset the scan
+    working set silently overshot ``HIST_ONEHOT_BUDGET``:
+
+    - the ``[C, F·Bp]`` ACCUMULATOR carry (4-byte lanes) is resident
+      for the whole scan regardless of block size, so it is subtracted
+      from the budget first (a carry alone past the budget floors the
+      block at 8 rows rather than pretending the budget holds);
+    - the per-block ``vals ⊗ onehot(slot)`` product adds
+      ``block·C·itemsize`` alongside the one-hot's ``block·F·Bp``.
+
+    At or below the shipped widths both terms are EXCLUDED so the
+    regression-pinned block shapes (and therefore the f32 accumulation
+    order — histograms are byte-identical only for identical block
+    partitions) of split_batch ∈ {1, 8, 16} stay exactly as before."""
+    per_row = num_features * padded_bins * int(itemsize)
+    budget = HIST_ONEHOT_BUDGET
+    from ..utils.shapes import HIST_CHANNEL_EXACT_MAX
+    if int(channels) > HIST_CHANNEL_EXACT_MAX:
+        per_row += int(channels) * int(itemsize)
+        budget -= int(channels) * num_features * padded_bins * 4
+    blk = max(budget, 0) // max(per_row, 1)
     return max(8, min(HIST_BLOCK_ROWS, blk // 8 * 8))
 
 
@@ -109,6 +132,14 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
                               num_slots: int = 1) -> jax.Array:
     n, f = binned.shape
     c = vals.shape[1] * (num_slots if slot is not None else 1)
+    # wide multi-leaf contractions (split_batch K ∈ {32, 64} → C = 3K
+    # ∈ {96, 192}) pad the channel axis to MXU lane multiples of 128
+    # (utils/shapes.bucket_channels) so the [block, C] accumuland
+    # operand fills whole 128-lane tiles; the pad columns belong to
+    # slots no row carries (exact zeros) and are sliced off below.
+    # Shipped widths (C <= 48) keep their exact shapes.
+    from ..utils.shapes import bucket_channels
+    c_pad = bucket_channels(c)
     # integer accumulands (quantized training): int8/int16 operands,
     # exact int32 accumulation on the MXU's low-precision path
     integer = jnp.issubdtype(vals.dtype, jnp.integer)
@@ -117,13 +148,21 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
 
     # static FLOP/byte accounting from the TRACED shapes (obs/flops.py;
     # a Python side effect, so it fires once per fresh trace and costs
-    # nothing at runtime — the comm.py trick applied to compute)
-    from ..obs.flops import hist_flops_bytes, note_traced
+    # nothing at runtime — the comm.py trick applied to compute).  The
+    # "hist" site carries the USEFUL channels only; the lane-pad MACs
+    # go to the MFU-excluded "hist_pad" site (phase="pad")
+    from ..obs.flops import (hist_flops_bytes, hist_pad_flops_bytes,
+                             note_traced)
     note_traced("hist", *hist_flops_bytes(
         n, f, num_bins, channels=c,
         binned_itemsize=getattr(binned.dtype, "itemsize", 1),
-        vals_itemsize=getattr(vals.dtype, "itemsize", 4)),
+        vals_itemsize=getattr(vals.dtype, "itemsize", 4),
+        slotted=slot is not None and num_slots > 1),
         phase="grow")
+    if c_pad > c:
+        note_traced("hist_pad", *hist_pad_flops_bytes(n, f, num_bins,
+                                                      channels=c),
+                    phase="pad")
 
     # Pad the bin axis to a multiple of 64 so the [blk, F, Bp] -> [blk, F*Bp]
     # merge is a free relayout (the minor dim tiles onto the 128-lane
@@ -135,7 +174,8 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
     bp = max(64, -(-num_bins // 64) * 64)
     if block_rows <= 0:
         block_rows = hist_block_rows(f, bp,
-                                     getattr(vals.dtype, "itemsize", 4))
+                                     getattr(vals.dtype, "itemsize", 4),
+                                     channels=c_pad)
     block_rows = min(block_rows, max(8, n))
 
     cv = vals.shape[1]                       # raw (unexpanded) channels
@@ -165,6 +205,11 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
             oh_s = (chunk[2][:, None] == kiota).astype(op_dt)
             vals_blk = (vals_blk[:, :, None] * oh_s[:, None, :]) \
                 .reshape(block_rows, c)
+        if c_pad > c:
+            # lane-pad the accumuland operand: the extra columns are
+            # exact zeros (no slot reaches them), sliced off after the
+            # scan, so they cost MXU cycles, never numerics
+            vals_blk = jnp.pad(vals_blk, ((0, 0), (0, c_pad - c)))
         onehot = (bins_blk.astype(jnp.int32)[:, :, None] == iota) \
             .astype(op_dt).reshape(block_rows, f * bp)
         # [C, block] x [block, F*Bp] -> [C, F*Bp]: the narrow C=3 axis maps
@@ -176,9 +221,9 @@ def _compute_histogram_matmul(binned: jax.Array, vals: jax.Array, *,
             preferred_element_type=acc_dt)
         return acc + h, None
 
-    acc0 = jnp.zeros((c, f * bp), dtype=acc_dt)
+    acc0 = jnp.zeros((c_pad, f * bp), dtype=acc_dt)
     acc, _ = lax.scan(body, acc0, xs)
-    return acc.reshape(c, f, bp).transpose(1, 2, 0)[:, :num_bins, :]
+    return acc[:c].reshape(c, f, bp).transpose(1, 2, 0)[:, :num_bins, :]
 
 
 def masked_histogram(binned: jax.Array, vals: jax.Array, leaf_of_row: jax.Array,

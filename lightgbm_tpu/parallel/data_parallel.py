@@ -128,7 +128,8 @@ def owner_hist_reduce(axis: str, n_shards: int, chunk: int,
 def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
                    params: SplitParams, max_depth: int = -1,
                    block_rows: int = 0, axis: str = "data", efb=None,
-                   split_batch: int = 1, mono=None,
+                   split_batch: int = 1, hist_overlap: bool = False,
+                   mono=None,
                    mono_penalty: float = 0.0, sparse: bool = False,
                    owner_shard: bool = True,
                    padded_leaves=None, quant=None):
@@ -147,7 +148,8 @@ def make_dp_grower(mesh: Mesh, *, num_leaves: int, num_bins: int,
     """
     kw = dict(num_leaves=num_leaves, num_bins=num_bins, params=params,
               max_depth=max_depth, block_rows=block_rows, axis=axis,
-              efb=efb, split_batch=split_batch, mono=mono,
+              efb=efb, split_batch=split_batch,
+              hist_overlap=hist_overlap, mono=mono,
               mono_penalty=mono_penalty, sparse=sparse,
               padded_leaves=padded_leaves, quant=quant)
     build = (lambda: _make_dp_owner_grower(mesh, **kw)) if owner_shard \
@@ -212,6 +214,7 @@ def _quant_hooks(axis: str, ledger: CommLedger, quant,
 
 def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                           max_depth, block_rows, axis, efb, split_batch,
+                          hist_overlap=False,
                           mono, mono_penalty, sparse, padded_leaves=None,
                           quant=None):
     """Owner-shard data-parallel grower (see module docstring)."""
@@ -279,7 +282,8 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
             sum_reduce=lambda t: ledger.psum(t, axis, site="dp.root_sum",
                                              cadence="tree"),
             hist_expand=hist_expand, select_best=select_best,
-            efb=efb, split_batch=split_batch, mono=mono,
+            efb=efb, split_batch=split_batch,
+            hist_overlap=hist_overlap, mono=mono,
             mono_view=None if mono is None else mono_view,
             mono_penalty=mono_penalty, padded_leaves=padded_leaves,
             **_quant_hooks(axis, ledger, quant),
@@ -356,6 +360,7 @@ def _make_dp_owner_grower(mesh: Mesh, *, num_leaves, num_bins, params,
 
 def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
                          max_depth, block_rows, axis, efb, split_batch,
+                         hist_overlap=False,
                          mono, mono_penalty, sparse, padded_leaves=None,
                          quant=None):
     """Legacy full-psum data-parallel grower: every shard receives ALL
@@ -371,7 +376,8 @@ def _make_dp_psum_grower(mesh: Mesh, *, num_leaves, num_bins, params,
         sum_reduce=lambda t: ledger.psum(t, axis, site="dp.root_sum",
                                          cadence="tree"),
         efb=efb,
-        split_batch=split_batch, mono=mono, mono_penalty=mono_penalty,
+        split_batch=split_batch, hist_overlap=hist_overlap,
+        mono=mono, mono_penalty=mono_penalty,
         padded_leaves=padded_leaves,
         **_quant_hooks(axis, ledger, quant), jit=False)
 

@@ -50,7 +50,8 @@ _SHARED_LOCK = threading.Lock()
 def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
                    num_bins: int, params: SplitParams, max_depth: int = -1,
                    block_rows: int = 0, axis: str = "feature",
-                   split_batch: int = 1, padded_leaves=None, quant=None):
+                   split_batch: int = 1, hist_overlap: bool = False,
+                   padded_leaves=None, quant=None):
     """Jitted feature-parallel ``grow_tree``.
 
     Inputs: binned [N, F] and vals replicated; feature metadata arrays
@@ -68,7 +69,7 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
            int(padded_leaves) if padded_leaves else None,
            None if padded_leaves else int(num_leaves),
            int(num_bins), params, int(max_depth), int(block_rows),
-           int(split_batch), quant)
+           int(split_batch), bool(hist_overlap), quant)
     jitted, ledger = memo_get_or_build(
         _SHARED, _SHARED_LOCK, _SHARED_MAX, key,
         lambda: _build(mesh, num_features=num_features,
@@ -76,6 +77,7 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
                        params=params, max_depth=max_depth,
                        block_rows=block_rows, axis=axis,
                        split_batch=split_batch,
+                       hist_overlap=hist_overlap,
                        padded_leaves=padded_leaves, quant=quant))
 
     def grow(binned, vals, feature_mask, num_bin, na_bin, na_bin_part=None,
@@ -94,8 +96,9 @@ def make_fp_grower(mesh: Mesh, *, num_features: int, num_leaves: int,
 
 
 def _build(mesh: Mesh, *, num_features, num_leaves, num_bins, params,
-           max_depth, block_rows, axis, split_batch, padded_leaves,
-           quant):
+           max_depth, block_rows, axis, split_batch, hist_overlap=False,
+           padded_leaves=None,
+           quant=None):
     n_shards = mesh.shape[axis]
     f_local = num_features // n_shards
     ledger = CommLedger(n_shards)     # static comm-bytes sites (obs/comm)
@@ -117,7 +120,8 @@ def _build(mesh: Mesh, *, num_features, num_leaves, num_bins, params,
         num_leaves=num_leaves, num_bins=num_bins, params=params,
         max_depth=max_depth, block_rows=block_rows,
         hist_view=hist_view, select_best=select_best,
-        split_batch=split_batch, padded_leaves=padded_leaves,
+        split_batch=split_batch, hist_overlap=hist_overlap,
+        padded_leaves=padded_leaves,
         # rows replicated: identical scales/rounding on every shard —
         # no scale pmax or row offset needed (module docstring)
         quant=quant, jit=False)

@@ -16,11 +16,18 @@ extracted so every layer buckets the same way:
   exits on the *actual* budget (a traced scalar), so ``num_leaves``
   31 / 40 / 63 all run the same ``L=64``-shaped program with
   bit-identical output (:func:`bucket_leaves`, grower.py).
-- **split_batch**: pinned to the shipped ``{1, 8, 16}`` set
-  (:func:`snap_split_batch`) — the auto-tuner only ever picks from it,
-  and snapping explicit odd values keeps the super-step trace family
-  closed (K is a structural constant of the trace, it cannot be made
-  dynamic the way the leaf budget can).
+- **split_batch**: pinned to the shipped ``{1, 8, 16, 32, 64}`` set
+  (:func:`snap_split_batch`) — the auto-tuner (ops/hist_tune.py) only
+  ever picks from it, and snapping explicit odd values keeps the
+  super-step trace family closed (K is a structural constant of the
+  trace, it cannot be made dynamic the way the leaf budget can).
+- **histogram channel axis** (the contraction's slot-expanded C = 3·K
+  channels): widths past the shipped C=48 ceiling pad to MXU lane
+  multiples of 128 (:func:`bucket_channels`) so the ``[block, C]``
+  accumuland operand lands on full 128-lane tiles — padded channels
+  belong to slots no row carries, accumulate exact zeros, and are
+  sliced off inside the kernel (ops/histogram.py), so the pad costs
+  MXU cycles only, never numerics.
 - **serve SoA dimensions** (node slots, leaf slots, traversal steps):
   power-of-two with floors (:func:`bucket_nodes`,
   :func:`bucket_leaf_slots`, :func:`bucket_steps`) so two co-hosted
@@ -47,8 +54,20 @@ LEAF_BUCKET_FLOOR = 64
 
 # the shipped split_batch widths (grower super-step K): 1 = strict
 # leaf-wise reference growth, 8/16 = the measured MXU-sublane sweet
-# spots (PROFILE.md §2-6; models/gbdt.py auto-selection)
-SPLIT_BATCH_SET = (1, 8, 16)
+# spots (PROFILE.md §2-6; models/gbdt.py auto-selection), 32/64 = the
+# lane-padded wide widths (ROADMAP item 1: C = 3K channels bucket to
+# 128-lane tiles, ops/histogram.py) the on-device autotuner
+# (ops/hist_tune.py) selects from by measured ms/pass
+SPLIT_BATCH_SET = (1, 8, 16, 32, 64)
+
+# channel widths up to the pre-widening ceiling (C = 3·16 = 48, the
+# largest shipped slot expansion before K ∈ {32, 64} existed) keep
+# their exact un-padded shapes: their histograms are regression-pinned
+# byte-identical, and at ≤ 48 channels the sublane mapping measured
+# fine (ops/histogram.py orientation note)
+HIST_CHANNEL_EXACT_MAX = 48
+# MXU lane width the wide channel axis pads to
+HIST_CHANNEL_LANE = 128
 
 
 def round_up_pow2(x: int) -> int:
@@ -118,6 +137,21 @@ def bucket_steps(depth: int, floor: int = 8) -> int:
     return _pow2_floor(depth, floor)
 
 
+def bucket_channels(c: int) -> int:
+    """Padded histogram-contraction channel width for a slot-expanded
+    C = cv·K axis: exact up to ``HIST_CHANNEL_EXACT_MAX`` (the shipped
+    pre-widening widths stay byte-identical down to the trace shape),
+    then the next ``HIST_CHANNEL_LANE`` multiple — K=32 (C=96) pads to
+    128, K=64 (C=192) to 256.  The pad columns are zero (no slot maps
+    to them) and sliced off in-kernel; obs/flops.py excludes their
+    FLOPs from MFU accounting (they are not useful work) while the
+    autotuner measures their real cost."""
+    c = int(c)
+    if c <= HIST_CHANNEL_EXACT_MAX:
+        return c
+    return -(-c // HIST_CHANNEL_LANE) * HIST_CHANNEL_LANE
+
+
 def snap_split_batch(k: int) -> int:
     """Nearest shipped super-step width >= the request (capped at the
     largest shipped width); 0/1 pass through untouched."""
@@ -128,3 +162,23 @@ def snap_split_batch(k: int) -> int:
         if k <= s:
             return s
     return SPLIT_BATCH_SET[-1]
+
+
+def fit_split_batch(k: int, num_leaves: int) -> int:
+    """Snap a super-step width into the shipped set AND under the leaf
+    budget: the grower can never split more than ``num_leaves - 1``
+    leaves in one step, so a width past the budget steps DOWN the set
+    (num_leaves=31 at K=32 runs K=16) instead of clamping to an
+    off-set width that would open a private trace family — K is a
+    structural constant of the grower trace, and leaf-budget padding
+    must never change it (padded and exact-shape growers of one config
+    train byte-identical trees)."""
+    k = snap_split_batch(k)
+    cap = int(num_leaves) - 1
+    if k <= cap:
+        return k
+    fit = 1
+    for s in SPLIT_BATCH_SET:
+        if s <= cap:
+            fit = s
+    return fit

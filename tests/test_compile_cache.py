@@ -74,9 +74,12 @@ class TestShapes:
         assert shapes.bucket_leaves(255) == 256
 
     def test_snap_split_batch(self):
+        # ISSUE 15 extended the shipped set to {1, 8, 16, 32, 64}: an
+        # off-set request still rounds UP within the set, and values
+        # past the widest snap down to it
         assert [shapes.snap_split_batch(v) for v in (0, 1, 2, 4, 8, 9,
-                                                     16, 40)] \
-            == [0, 1, 8, 8, 8, 16, 16, 16]
+                                                     16, 40, 64, 99)] \
+            == [0, 1, 8, 8, 8, 16, 16, 64, 64, 64]
 
     def test_serve_engine_uses_shared_policy(self, sweep_data):
         from lightgbm_tpu.serve.engine import PredictorEngine

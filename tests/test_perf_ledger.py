@@ -139,6 +139,10 @@ class TestTracedSites:
         for site in ("hist", "split_scan", "partition"):
             assert site in ts, f"site {site!r} never registered"
         itemsize = int(m.binned_dev.dtype.itemsize)
+        # the last-traced hist note is the smaller-child pass; under
+        # the default hist_overlap its 1-slot mask is accounted as the
+        # masked pass it is byte-identical to (num_slots == 1 adds no
+        # slot-operand bytes — obs/flops.hist_flops_bytes convention)
         exp_f, exp_b = hist_flops_bytes(
             m.num_data, int(m.binned_dev.shape[1]), m.max_bin,
             channels=3, binned_itemsize=itemsize)

@@ -87,6 +87,66 @@ class TestWarmStart:
         assert warm["pred"] == cold["pred"]
 
 
+# autotuner warm start (ISSUE 15, ops/hist_tune.py): the FIRST process
+# pays the (K, block_rows) sweep and persists both the choice
+# (hist_tune.json) and the compiled traces it leads to; a SECOND
+# process against the same directory must re-tune zero times and
+# compile zero times.
+_TUNE_SCRIPT = r"""
+import json, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops import hist_tune
+from lightgbm_tpu.utils.compile_cache import compile_stats
+cache_dir = sys.argv[1]
+rs = np.random.RandomState(0)
+x = rs.randn(400, 6)
+y = (x[:, 0] - x[:, 1] + 0.2 * rs.randn(400) > 0).astype(np.float32)
+p = {"objective": "binary", "num_leaves": 33, "verbosity": 0,
+     "min_data_in_leaf": 5, "max_bin": 15, "tpu_learner": "masked",
+     "fused_chunk": 0, "hist_tune": "on", "split_batch": 0,
+     "compile_cache_dir": cache_dir, "compile_cache_min_compile_s": 0.0}
+ds = lgb.Dataset(x, label=y, params=p)
+bst = lgb.train(p, ds, num_boost_round=2)
+rec = {"sweeps": hist_tune.tune_counts()["sweeps"],
+       "pred": np.asarray(bst.predict(x[:4])).round(8).tolist()}
+rec.update(compile_stats())
+print("TUNE " + json.dumps(rec))
+"""
+
+
+class TestAutotunerWarmStart:
+    def test_second_process_reuses_choice_and_traces(self, tmp_path):
+        cache = str(tmp_path / "cache")
+
+        def run():
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            out = subprocess.run(
+                [sys.executable, "-c", _TUNE_SCRIPT, cache],
+                capture_output=True, text=True, timeout=420, env=env,
+                cwd=REPO)
+            assert out.returncode == 0, out.stderr[-3000:]
+            for line in out.stdout.splitlines():
+                if line.startswith("TUNE "):
+                    return json.loads(line[5:])
+            raise AssertionError(out.stdout)
+
+        cold = run()
+        warm = run()
+        # first fit per (platform, shape bucket): exactly one sweep,
+        # persisted next to the compile cache
+        assert cold["sweeps"] == 1
+        assert os.path.exists(os.path.join(cache, "hist_tune.json"))
+        # second process: zero re-tune, zero re-compile (the sweep's
+        # own traces AND the tuned grower all hit the persistent
+        # cache), and the tuned choice reproduces the same model
+        assert warm["sweeps"] == 0, warm
+        assert warm["cache_misses"] == 0, warm
+        assert warm["pred"] == cold["pred"]
+
+
 class TestRetraceLint:
     """The lint re-runs the whole canonical matrix in a fresh
     subprocess (~15 s with a warm persistent cache — which tier-1's own

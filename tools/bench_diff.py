@@ -54,14 +54,19 @@ BUDGET = os.path.join(REPO, "tools", "perf_budget.txt")
 # direction-by-name defaults for --update: latency/compile/freshness
 # metrics gate downward, everything else (rates, MFU) upward
 _LOWER_BETTER = re.compile(
-    r"(_ms|compile_s|_seconds|_lag_s|_gen_s|_hbm_bytes_per_iter)$")
+    r"(_ms|compile_s|_seconds|_lag_s|_gen_s|_hbm_bytes_per_iter"
+    r"|_ms_per_pass|_ms_per_leaf(_k\d+|_wide)?)$")
 # extras worth gating by default: primary value, throughput points,
 # serve latency/throughput (host-accumulation AND fused device paths),
-# mfu, and the continual pipeline's freshness numbers
+# mfu, the continual pipeline's freshness numbers, and the histogram
+# contraction's measured pass/per-leaf costs (ISSUE 15 — both the
+# hist_* headline aliases and the per-width hist_quant_* sweep keys)
 _GATEABLE = re.compile(
     r"(^value$|_iters_per_sec$|^serve(_device)?_rows_per_s$"
     r"|^serve(_device)?_p\d+_ms$|_mfu$|_compile_s$"
     r"|^hist_hbm_bytes_per_iter$"
+    r"|^hist_ms_per_(pass|leaf_k\d+|leaf_wide)$"
+    r"|^hist_quant_q(off|8|16)_k\d+_ms_per_(pass|leaf)$"
     r"|^continual_(freshness_lag_s|gen_s)$)")
 _DEFAULT_TOL = {"higher": 0.20, "lower": 0.30}
 

@@ -178,13 +178,22 @@ def sharded_main():
 
 def run_quant_bench(n_rows: int = 200_000, reps: int = 5,
                     quants=("off", "8", "16"), ks=(16, 32, 64),
-                    f: int = 28, num_bins: int = 63) -> dict:
+                    f: int = 28, num_bins: int = 63,
+                    tune: bool = True) -> dict:
     """Quantized-vs-f32 histogram contraction sweep over the
-    split_batch slot widths K — the SHIPPED kernel (compute_histogram),
-    not a bench-local variant, so dtype dispatch, block sizing
-    (hist_block_rows by vals itemsize) and the int32 accumulation are
-    exactly what training runs.  Returns a flat dict bench.py folds
-    into extras as ``hist_quant_<key>``."""
+    split_batch slot widths K in {16, 32, 64} — the SHIPPED kernel
+    (compute_histogram), not a bench-local variant, so dtype dispatch,
+    block sizing (hist_block_rows by vals itemsize AND the wide
+    channel/accumulator budget), the MXU lane padding of the wide
+    widths (C=96 -> 128, C=192 -> 256) and the int32 accumulation are
+    exactly what training runs.  Per width both the raw ``ms_per_pass``
+    and the decision metric ``ms_per_leaf`` (= ms/pass / K — a wider
+    pass may cost more wall and still win per split) are recorded;
+    with ``tune`` the REAL autotuner (ops/hist_tune.py, in-memory
+    table only — the bench must not poison the training cache) runs on
+    the same shape and its chosen (K, block_rows) lands in the record
+    as ``tuned_k`` / ``tuned_block_rows``.  Returns a flat dict
+    bench.py folds into extras as ``hist_quant_<key>``."""
     import jax as _jax
     import jax.numpy as _jnp
     from lightgbm_tpu.obs.flops import hist_flops_bytes, padded_bins
@@ -229,10 +238,27 @@ def run_quant_bench(n_rows: int = 200_000, reps: int = 5,
             fl, hb = hist_flops_bytes(n_rows, f, num_bins,
                                       channels=3 * k, vals_itemsize=isz)
             out[f"q{q}_k{k}_ms_per_pass"] = round(t * 1e3, 3)
+            out[f"q{q}_k{k}_ms_per_leaf"] = round(t * 1e3 / k, 4)
             out[f"q{q}_k{k}_tflops"] = round(fl / t / 1e12, 4)
+            out[f"q{q}_k{k}_intensity"] = round(fl / hb, 2)
         _, hb1 = hist_flops_bytes(n_rows, f, num_bins, channels=3,
                                   vals_itemsize=isz)
         out[f"q{q}_hbm_bytes_per_pass"] = hb1
+        if tune:
+            # the autotuner's own verdict for this (shape, dtype): an
+            # in-memory sweep (no table writes) so every bench point
+            # carries the chosen (K, block_rows) as provenance
+            try:
+                from lightgbm_tpu.ops.hist_tune import tune as _tune
+                rec = _tune(n_rows, f, num_bins, itemsize=isz,
+                            kmax=max(ks), reps=max(2, reps // 2))
+                out[f"q{q}_tuned_k"] = rec["k"]
+                out[f"q{q}_tuned_block_rows"] = rec["block_rows"]
+                if q == "off":
+                    out["tuned_k"] = rec["k"]
+                    out["tuned_block_rows"] = rec["block_rows"]
+            except Exception as e:      # bench never dies on the tuner
+                out[f"q{q}_tuned_error"] = f"{type(e).__name__}: {e}"[:80]
     out.update(n_rows=n_rows, f=f, num_bins=num_bins,
                padded_bins=padded_bins(num_bins), reps=reps)
     return out

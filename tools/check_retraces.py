@@ -127,6 +127,17 @@ def run_matrix() -> Dict[str, int]:
         _train(lgb, x, y, bagging_fraction=0.7, bagging_freq=1)
         _train(lgb, x, y, data_sample_strategy="goss")
 
+    # 2b. wide super-step (ISSUE 15): a num_leaves sweep at K=32 stays
+    #    ONE grower trace — both budgets bucket onto L=64 and the
+    #    lane-padded C=96->128 channel axis is a structural constant,
+    #    so the wide trace family is exactly as closed as the shipped
+    #    K<=16 one (33, not 31: at 31 leaves K=32 fits DOWN to 16 by
+    #    utils/shapes.fit_split_batch, which is the other half of the
+    #    width contract)
+    with _Scope("hist_k32", measured):
+        for nl in (33, 63):
+            _train(lgb, x, y, num_leaves=nl, split_batch=32)
+
     # 3. two valid-set sizes row-bucket onto one traversal shape, so
     #    early stopping over mixed valid sets stops re-tracing
     with _Scope("valid_sizes", measured):
