@@ -230,6 +230,10 @@ def _train_point(lgb, x, y, num_leaves, chunk, n_chunks, tag, ds=None,
         "backend_compiles": cs1["count"] - cs0["count"],
         "compile_cache_hits": cs1["cache_hits"] - cs0["cache_hits"],
     }
+    if not fused:
+        # provenance: WHY this point measured the per-iteration path
+        # (GBDTModel.fused_reasons — specific blockers, never a guess)
+        stats["fused_reasons"] = "; ".join(m.fused_reasons())[:200]
 
     from lightgbm_tpu.metrics import _auc
     auc = _auc(y, np.asarray(m.train_score())[:, 0], None)
@@ -547,6 +551,27 @@ def child_extras() -> None:
             tuned_block_rows=qp.get("tuned_block_rows"))
     except Exception as e:
         _record_point("hist_quant", error=f"{type(e).__name__}: {e}"[:200])
+
+    # super-epoch sweep (ISSUE 16, tools/bench_fused.sweep): k in
+    # {1, 8, 32} x {valid, novalid} end-to-end lgb.train runs — k=1 is
+    # the per-iteration baseline — counting jax.device_get syncs during
+    # the timed run.  Headline keys fold as superepoch_iters_per_s /
+    # superepoch_sync_count_per_iter (the k=32 + one-valid + ES
+    # acceptance shape, pinned in tools/perf_budget.txt: the sync count
+    # is structural, 1/k, near-zero tolerance)
+    try:
+        sys.path.insert(0, os.path.join(_DIR, "tools"))
+        import bench_fused
+        # CPU shape is deliberately small: at 20k rows x 31 leaves one
+        # 32-round train is ~70 s on CPU, and the sweep runs each
+        # (k, valid) cell twice (warmup + timed)
+        sp = bench_fused.sweep(
+            n_rows=10_000 if cpu else 400_000,
+            ks=(1, 32) if cpu else (1, 8, 32),
+            rounds=32 if cpu else None)
+        _record_point("superepoch", cpu=cpu, **sp)
+    except Exception as e:
+        _record_point("superepoch", error=f"{type(e).__name__}: {e}"[:200])
 
     # comm wire bytes per boosting iteration (obs/comm.py static model,
     # same math the telemetry counters use at train time): the in-flight

@@ -218,11 +218,52 @@ class Booster:
         self._sync_trees()
         return stopped
 
+    def update_superepoch(self, k: int, es_it0: int, eval_spec=(),
+                          es_spec=None) -> dict:
+        """Run ``k`` FULL iterations — growth, score updates, valid-set
+        scoring, traced metric eval, early-stop vote — fused in one
+        device program with ONE host fetch (GBDTModel.train_superepoch).
+        Returns the fetched replay block for engine.train's host-side
+        callback replay."""
+        out = self._model.train_superepoch(k, es_it0, eval_spec, es_spec)
+        self._sync_trees()
+        return out
+
     def supports_fused(self) -> bool:
         return (self._model is not None
                 and hasattr(self._model, "supports_fused")
                 and self._model.supports_fused()
                 and not self._model.valid_sets)
+
+    def fused_reasons(self) -> List[str]:
+        """Why ``supports_fused()`` is False — specific blockers, empty
+        when fusion is eligible (GBDTModel.fused_reasons; bench
+        provenance and error messages)."""
+        if self._model is None or not hasattr(self._model,
+                                              "fused_reasons"):
+            return ["no active training model"]
+        return self._model.fused_reasons()
+
+    def eval_valid_traced(self) -> List[Tuple]:
+        """Every valid-set metric evaluated by the TRACED metric kernels
+        in one jitted program + ONE host fetch — the SAME program
+        (metrics.build_traced_eval) the super-epoch replay reports
+        through, so a ``fused_eval=true`` per-iteration run produces
+        bit-identical eval values to a super-epoch run (the
+        byte-identity contract the tests pin); the host f64 ``eval_*``
+        path stays available via ``fused_eval=false``."""
+        m = self._model
+        spec = tuple(
+            (vi, name, mt.name, mt.is_higher_better)
+            for vi, name in enumerate(self._valid_names)
+            for mt in self._valid_metrics[vi])
+        fn = m._teval_fn(spec)
+        svecs = tuple(vs[:, 0] for _, _, vs in m.valid_sets)
+        ops = tuple(m._se_valid_dev(vi)
+                    for vi in range(len(m.valid_sets)))
+        vals = m._eget(fn(svecs, ops), "traced_eval")
+        return [(name, mn, float(vals[e]), hib)
+                for e, (vi, name, mn, hib) in enumerate(spec)]
 
     def rollback_one_iter(self) -> "Booster":
         self._model.rollback_one_iter()

@@ -42,6 +42,10 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
                             for r in env.evaluation_result_list)
             print(f"[{env.iteration + 1}]\t{msg}")
     _callback.order = 10
+    # pure function of the CallbackEnv — the super-epoch replay
+    # (engine.py) can feed it fetched eval rows after the fact and the
+    # output is identical to the per-iteration path
+    _callback._replayable = True
     return _callback
 
 
@@ -66,6 +70,8 @@ def record_evaluation(eval_result: Dict) -> Callable:
                 eval_result[dsname].setdefault(f"{metric}-stdv",
                                                []).append(item[4])
     _callback.order = 20
+    # env-pure: replayable from a super-epoch's fetched eval block
+    _callback._replayable = True
     return _callback
 
 
@@ -248,4 +254,14 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                           "\t".join(_fmt_eval(r) for r in bsl))
                 raise EarlyStopException(best_iter[i], bsl)
     _callback.order = 30
+    # env-pure state machine: the super-epoch replay (engine.py) feeds
+    # it the SAME (iteration, evaluation_result_list) stream the
+    # per-iteration path would, so best_iteration/best_score come out
+    # byte-identical.  _es_spec lets the engine mirror the closure as a
+    # traced in-scan vote (models/gbdt.py) that predicts the stop row —
+    # only the scalar min_delta == 0 form is traced (engine gates)
+    _callback._replayable = True
+    _callback._es_spec = {"stopping_rounds": stopping_rounds,
+                          "first_metric_only": first_metric_only,
+                          "min_delta": min_delta}
     return _callback

@@ -202,6 +202,21 @@ _PARAMS: Dict[str, tuple] = {
     # round-trip (measured ~67 ms on a tunneled chip) over the chunk.
     # 0/1 disables fusion.
     "fused_chunk": (int, 25, []),
+    # super-epoch trainer (docs/Fused-Training.md): lax.scan over k FULL
+    # boosting iterations — grow + score update + traced metric eval
+    # over the bucketed validation sets + an early-stop vote carried as
+    # a traced flag — with exactly ONE host sync per epoch.  0 = auto
+    # (engine picks k from fused_chunk / early_stopping_round when the
+    # config qualifies), >0 = explicit epoch size, -1 = disable (always
+    # per-iteration eval)
+    "superepoch": (int, 0, []),
+    # traced on-device metric evaluation (metrics.traced_metric_fn):
+    # "auto" uses traced (f32) eval wherever the super-epoch engages and
+    # host (f64) eval elsewhere; "true" forces traced eval in the
+    # per-iteration loop too (the byte-identity partner of the scan
+    # path); "false" disables traced eval AND the super-epoch whenever
+    # validation sets are attached
+    "fused_eval": (str, "auto", []),
     # quantized training (docs/Quantized-Training.md, ROADMAP item 3):
     # pack per-row gradients/hessians to int8/int16 with one shared
     # per-channel scale per iteration and stochastic rounding, and

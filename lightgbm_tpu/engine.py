@@ -199,6 +199,107 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # a resumed run's global round index carries the offset
             start_round = resume_start + booster.current_iteration
 
+    # super-epochs: the whole-run on-device path — k FULL iterations
+    # (growth + score + valid scoring + traced eval + early-stop vote)
+    # per device program, ONE host sync each, then the fetched eval
+    # block replayed through the REAL callbacks so record_evals /
+    # early_stopping / best_iteration are byte-identical per-iteration
+    se_plan = None if chunk_stopped else _superepoch_plan(
+        cfg, booster, fobj, feval, cbs_before, cbs_after,
+        train_eval_name)
+    if se_plan is not None:
+        base_k, eval_spec, es_spec = se_plan
+        from .utils.log import Log
+        while not chunk_stopped:
+            k_eff = min(base_k, num_boost_round - start_round)
+            if cfg.snapshot_freq > 0:
+                # clip to the snapshot boundary so periodic snapshots
+                # land at EXACTLY the per-iteration cadence
+                k_eff = min(k_eff, cfg.snapshot_freq
+                            - start_round % cfg.snapshot_freq)
+            if k_eff < 2:
+                break
+            out = booster.update_superepoch(k_eff, start_round,
+                                            eval_spec, es_spec)
+            done = out["done"]
+            if cfg.snapshot_freq > 0 and done == k_eff \
+                    and (start_round + done) % cfg.snapshot_freq == 0:
+                # per-iteration order is update -> snapshot -> evals ->
+                # callbacks, so the boundary snapshot is written BEFORE
+                # the replay may raise EarlyStopException
+                from .snapshot import write_snapshot
+                try:
+                    write_snapshot(booster, prev_booster, cfg,
+                                   start_round + done, snap_sig,
+                                   train_set)
+                except Exception as e:
+                    Log.warning(f"snapshot at iteration "
+                                f"{start_round + done} failed ({e}); "
+                                "training continues")
+            es_raised = False
+            for j in range(done):
+                ev_row = [(nm, mn, float(out["evals"][j][e]), hib)
+                          for e, (_vi, nm, mn, hib)
+                          in enumerate(eval_spec)]
+                env = CallbackEnv(model=booster, params=params,
+                                  iteration=start_round + j,
+                                  begin_iteration=0,
+                                  end_iteration=num_boost_round,
+                                  evaluation_result_list=ev_row)
+                try:
+                    for cb in cbs_after:
+                        cb(env)
+                except EarlyStopException as e:
+                    booster.best_iteration = (best_iter_offset
+                                              + e.best_iteration + 1)
+                    for (name, metric, value, _) in e.best_score:
+                        booster.best_score.setdefault(
+                            name, {})[metric] = value
+                    es_raised = True
+                    extra = done - (j + 1)
+                    if extra > 0:
+                        # defensive: the traced vote and this replay
+                        # consume the SAME fetched f32 values, so they
+                        # agree on the stop row — heal by slicing the
+                        # surplus trees if they ever don't
+                        Log.warning(
+                            "super-epoch vote overshot the host early "
+                            f"stop by {extra} iteration(s); dropping "
+                            "surplus trees")
+                        booster._model.drop_iterations(extra)
+                        booster._sync_trees()
+                    break
+            if es_raised or out["stump"]:
+                chunk_stopped = True
+            elif out["stop_row"] is not None:
+                # vote tripped but the replay did not raise (defensive
+                # mirror of the overshoot case): trust the host, clear
+                # the latch, keep training
+                Log.warning("super-epoch early-stop vote tripped but "
+                            "the host callbacks did not; resuming")
+                booster._model.clear_es_stop()
+            start_round = resume_start + booster.current_iteration
+        if not chunk_stopped and start_round < num_boost_round \
+                and eval_spec:
+            # remainder rounds run per-iteration but keep the TRACED
+            # metric values, so the whole run's record_evals stays
+            # bit-identical to a pure super-epoch run
+            booster._traced_eval = True
+    elif str(cfg.fused_eval).lower() == "true" and feval is None \
+            and booster._valid_names \
+            and getattr(booster, "_model", None) is not None:
+        # fused_eval=true: per-iteration runs evaluate via the traced
+        # metric kernels too (ONE fetch per iteration for all metrics)
+        # — the reference twin the super-epoch byte-identity tests
+        # compare against
+        import jax
+        from .metrics import traced_metric_fn
+        if all(traced_metric_fn(mt.name, cfg) is not None
+               for ms in booster._valid_metrics for mt in ms) \
+                and all(isinstance(vb, jax.Array) for _, vb, _
+                        in booster._model.valid_sets):
+            booster._traced_eval = True
+
     for i in range(start_round, num_boost_round if not chunk_stopped else 0):
         env = CallbackEnv(model=booster, params=params, iteration=i,
                           begin_iteration=0, end_iteration=num_boost_round,
@@ -237,7 +338,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 or train_eval_name is not None:
             if cfg.is_provide_training_metric or train_eval_name is not None:
                 evals.extend(booster.eval_train(feval))
-            evals.extend(booster.eval_valid(feval))
+            if getattr(booster, "_traced_eval", False) and feval is None:
+                evals.extend(booster.eval_valid_traced())
+            else:
+                evals.extend(booster.eval_valid(feval))
         if evals:
             # flight recorder: fold the train/valid metrics (computed
             # after the iteration record landed) into that record
@@ -266,6 +370,85 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.tree_weights = (prev_booster.tree_weights
                                 + booster.tree_weights)
     return booster
+
+
+def _superepoch_plan(cfg, booster, fobj, feval, cbs_before, cbs_after,
+                     train_eval_name):
+    """Decide whether the super-epoch trainer (GBDTModel.
+    train_superepoch) can drive this run, and with what epoch size.
+    Returns ``(base_k, eval_spec, es_spec)`` or None for the
+    per-iteration path.  Requirements (docs/Fused-Training.md): the
+    fused-path model config, no custom fobj/feval, no training-set
+    eval, only replay-safe callbacks, dense device valid sets whose
+    metrics all have traced kernels, and at most one early-stopping
+    callback in its scalar ``min_delta == 0`` form."""
+    if cfg.superepoch == -1:
+        return None
+    if not (cfg.superepoch > 0 or cfg.fused_chunk > 1):
+        return None
+    if fobj is not None or feval is not None:
+        return None
+    if cfg.is_provide_training_metric or train_eval_name is not None:
+        return None
+    if cfg.verbosity > 1:
+        return None       # per-iteration elapsed-time logging
+    if cbs_before:
+        return None
+    if any(not getattr(cb, "_replayable", False) for cb in cbs_after):
+        return None
+    model = getattr(booster, "_model", None)
+    if model is None or not hasattr(model, "train_superepoch"):
+        return None
+    if not model._fusable_config() or model._faults_active():
+        return None
+    import jax
+    if str(cfg.fused_eval).lower() == "false" and model.valid_sets:
+        return None
+    if any(not isinstance(vb, jax.Array)
+           for _, vb, _ in model.valid_sets):
+        return None       # sparse-binned valid rows: no in-scan walk
+    from .metrics import traced_metric_fn
+    eval_spec = []
+    for vi, name in enumerate(booster._valid_names):
+        for mt in booster._valid_metrics[vi]:
+            if traced_metric_fn(mt.name, cfg) is None:
+                return None
+            eval_spec.append((vi, name, mt.name,
+                              bool(mt.is_higher_better)))
+    eval_spec = tuple(eval_spec)
+    es_cbs = [cb for cb in cbs_after
+              if getattr(cb, "_es_spec", None) is not None]
+    if len(es_cbs) > 1:
+        return None
+    es_spec = None
+    if es_cbs:
+        spec = es_cbs[0]._es_spec
+        md = spec["min_delta"]
+        if isinstance(md, (list, tuple)) or float(md) != 0.0:
+            return None
+        # which entries the host closure's trip-check actually reaches:
+        # 'training'-named sets and first_metric_only mismatches update
+        # their best but never raise (callback.early_stopping)
+        first_metric = eval_spec[0][2].split("@")[0] if eval_spec else ""
+        eligible = tuple(
+            (nm != "training")
+            and (not spec["first_metric_only"]
+                 or mn.split("@")[0] == first_metric)
+            for (_vi, nm, mn, _h) in eval_spec)
+        es_spec = {"stopping_rounds": int(spec["stopping_rounds"]),
+                   "first_metric_only": bool(spec["first_metric_only"]),
+                   "eligible": eligible}
+    # epoch size: explicit superepoch wins; auto sizes to the fused
+    # chunk, bounded by the early-stop horizon so a stop wastes at most
+    # ~one epoch of post-stop (zeroed) in-scan iterations
+    if cfg.superepoch > 0:
+        base_k = cfg.superepoch
+    elif es_spec is not None:
+        base_k = max(2, min(cfg.fused_chunk,
+                            es_spec["stopping_rounds"]))
+    else:
+        base_k = cfg.fused_chunk
+    return max(int(base_k), 2), eval_spec, es_spec
 
 
 def _dataset_raw(ds: Dataset):

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .config import Config
@@ -362,6 +364,159 @@ class KLDivMetric(Metric):
         y = np.clip(self.label, 1e-15, 1 - 1e-15)
         kl = (y * np.log(y / p) + (1 - y) * np.log((1 - y) / (1 - p)))
         return [(self.name, self._avg(kl), False)]
+
+
+# ---- traced (jit-able) metric forms ---------------------------------------
+#
+# Device-resident evaluation for the super-epoch trainer
+# (models/gbdt.py train_superepoch) and the booster's fused_eval path:
+# each factory returns a pure ``(score, label, weight) -> f32 scalar``
+# that jits into the training scan (or a standalone eval program) over
+# PADDED valid buckets.  Padding rows carry weight 0.0, so every traced
+# metric is a weighted mean/ratio that ignores them by construction —
+# the caller always passes a weight vector (ones where the user gave
+# none, zeros on the pad tail).  Metrics without a traced form return
+# None from traced_metric_fn, which gates the engine back to the
+# per-iteration host path.  Traced values are f32 (the host metrics
+# compute in f64): the byte-identity contract is traced-vs-traced
+# (superepoch vs fused_eval="true" per-iteration — docs/Fused-
+# Training.md), while the clip floor is widened to 1e-7 because
+# ``1 - 1e-15`` rounds to 1.0 in f32 and would emit inf on saturated
+# scores.
+
+def _t_wavg(per_row, w):
+    return jnp.sum(per_row * w) / jnp.sum(w)
+
+
+def _t_binary_logloss(config: Config):
+    sig = float(config.sigmoid)
+
+    def fn(score, label, weight):
+        p = 1.0 / (1.0 + jnp.exp(-sig * score))
+        p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
+        ll = -(label * jnp.log(p) + (1.0 - label) * jnp.log(1.0 - p))
+        return _t_wavg(ll, weight)
+    return fn
+
+
+def _t_auc(config: Config):
+    # exact tie-aware weighted AUC, the _auc() recurrence in traced
+    # form: stable ascending sort, tie groups via a cumsum of
+    # score-change flags, per-group negative mass via segment_sum —
+    # pad rows have weight 0 so joining a tie group changes nothing
+    def fn(score, label, weight):
+        order = jnp.argsort(score, stable=True)
+        s, y, w = score[order], label[order], weight[order]
+        pos_w = jnp.where(y > 0, w, 0.0)
+        neg_w = jnp.where(y <= 0, w, 0.0)
+        newgrp = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             (s[1:] != s[:-1]).astype(jnp.int32)])
+        gid = jnp.cumsum(newgrp)
+        grp_neg = jax.ops.segment_sum(neg_w, gid,
+                                      num_segments=s.shape[0])
+        cum_before = jnp.cumsum(grp_neg) - grp_neg
+        rank_neg = cum_before[gid] + 0.5 * grp_neg[gid]
+        area = jnp.sum(pos_w * rank_neg)
+        tp, tn = jnp.sum(pos_w), jnp.sum(neg_w)
+        return jnp.where((tp > 0) & (tn > 0), area / (tp * tn),
+                         jnp.float32(1.0))
+    return fn
+
+
+def _t_l2(config: Config):
+    def fn(score, label, weight):
+        return _t_wavg((label - score) ** 2, weight)
+    return fn
+
+
+def _t_rmse(config: Config):
+    def fn(score, label, weight):
+        return jnp.sqrt(_t_wavg((label - score) ** 2, weight))
+    return fn
+
+
+def _t_l1(config: Config):
+    def fn(score, label, weight):
+        return _t_wavg(jnp.abs(label - score), weight)
+    return fn
+
+
+def _t_multi_logloss(config: Config):
+    # score: [N, K] raw — parity partner for MultiLoglossMetric; the
+    # scan path never reaches it (num_class > 1 is unfusable) but the
+    # fused_eval="true" per-iteration path does
+    def fn(score, label, weight):
+        s = score - jnp.max(score, axis=1, keepdims=True)
+        p = jnp.exp(s)
+        p = p / jnp.sum(p, axis=1, keepdims=True)
+        idx = label.astype(jnp.int32)
+        picked = jnp.take_along_axis(p, idx[:, None], axis=1)[:, 0]
+        ll = -jnp.log(jnp.clip(picked, 1e-7, None))
+        return _t_wavg(ll, weight)
+    return fn
+
+
+_TRACED_METRICS: Dict[str, Callable[[Config], Callable]] = {
+    "binary_logloss": _t_binary_logloss,
+    "auc": _t_auc,
+    "l2": _t_l2,
+    "rmse": _t_rmse,
+    "l1": _t_l1,
+    "multi_logloss": _t_multi_logloss,
+}
+
+
+def traced_metric_fn(name: str, config: Config) -> Optional[Callable]:
+    """Jit-able ``(score, label, weight) -> f32 scalar`` for ``name``,
+    or None when the metric has no traced form (engine falls back to
+    per-iteration host eval)."""
+    mk = _TRACED_METRICS.get(name)
+    return mk(config) if mk is not None else None
+
+
+def build_traced_eval(eval_spec: Sequence[Tuple],
+                      config: Config) -> Optional[Callable]:
+    """The ONE jitted eval program both fused paths report through.
+
+    ``eval_spec`` is a tuple of ``(valid_idx, set_name, metric_name,
+    higher_better)`` entries in ``booster.eval_valid()`` order; the
+    returned ``teval(svecs, ops)`` evaluates every entry over device
+    score VECTORS (``svecs[vi]``: f32 ``[rows]``) and padded
+    ``(label, weight)`` pairs (``ops[vi]``), returning an f32 ``[E]``
+    stack.  Returns None when any metric lacks a traced form.
+
+    Why a shared program instead of evaluating inside the training
+    scan: XLA may fuse a reduction differently depending on the
+    surrounding program, and different fusion can round the last ulp
+    differently even on bitwise-identical inputs.  The super-epoch
+    trainer therefore evaluates its in-scan metrics only to drive the
+    early-stop VOTE, and recomputes the REPORTED values post-scan
+    through this program — the same one ``fused_eval="true"``
+    per-iteration runs use — so record_evals are bit-identical across
+    the two paths by construction (docs/Fused-Training.md)."""
+    spec = tuple(eval_spec)
+    fns = tuple(traced_metric_fn(mn, config)
+                for (_vi, _n, mn, _h) in spec)
+    if any(f is None for f in fns):
+        return None
+    from .obs.flops import eval_flops_bytes, note_traced
+    from .utils.compile_cache import trace_event
+
+    @jax.jit
+    def teval(svecs, ops):
+        trace_event("traced_eval")
+        if not spec:
+            return jnp.zeros((0,), jnp.float32)
+        note_traced("fused_eval",
+                    *eval_flops_bytes(
+                        sum(int(s.shape[0]) for s in svecs)
+                        // max(len(svecs), 1), len(spec)),
+                    phase="eval", cadence="iter")
+        return jnp.stack([
+            f(svecs[vi], ops[vi][0], ops[vi][1])
+            for f, (vi, _n, _mn, _h) in zip(fns, spec)])
+    return teval
 
 
 _METRICS = {

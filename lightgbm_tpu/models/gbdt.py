@@ -12,7 +12,10 @@ reference text format by the Booster layer.
 
 from __future__ import annotations
 
+import copy
 import math
+import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -31,6 +34,20 @@ from ..tree_model import Tree
 # outputs with 0 (NaN) or ±this bound (infinities) — large enough not to
 # distort healthy training, small enough that squares stay in f32 range
 _FINITE_CLAMP = 1e30
+
+# process-level super-epoch program sharing (the grower._SHARED_GROWERS
+# pattern one layer up): the jitted k-iteration scan closes over NO
+# data-derived device arrays — binned matrices, bin metadata, objective
+# arrays and valid-set operands all ride in as ARGUMENTS — so two
+# boosters whose configs match (31/63 num_leaves collapse onto one
+# L=64 leaf bucket) reuse ONE compiled super-epoch.  Keyed on the full
+# config plus every shape-/semantics-relevant static; any unkeyable
+# state (EFB bundles, categorical flags, CEGB, monotone/interaction
+# constraints, multi-process meshes) falls back to a private per-model
+# jit in ``self._fused_cache`` — correct, just not shared.
+_SE_CACHE: "OrderedDict[tuple, object]" = OrderedDict()
+_SE_CACHE_MAX = 8
+_SE_CACHE_LOCK = threading.Lock()
 
 
 class _DeviceTree:
@@ -686,6 +703,11 @@ class GBDTModel:
 
         # validation sets: (dataset, device binned, score)
         self.valid_sets: List[Tuple[Dataset, jax.Array, jax.Array]] = []
+        # super-epoch traced early-stop vote state, carried ON DEVICE
+        # across epochs: (best [E] f32, best_iter [E] i32, has-best [E]
+        # bool, stop scalar bool) — see train_superepoch
+        self._es_dev = None
+        self._se_valid_cache: Dict[int, Tuple[jax.Array, jax.Array]] = {}
 
         self.models: List[Tree] = []          # host trees, grouped per iter
         self.device_trees: List[_DeviceTree] = []
@@ -1370,6 +1392,61 @@ class GBDTModel:
         from ..utils import faultinject
         return faultinject.enabled()
 
+    def fused_reasons(self) -> List[str]:
+        """Every reason ``supports_fused()`` is False, as specific
+        human-readable blockers — empty when the fused path is
+        eligible.  The ``reasons()`` companion of ``supports_fused()``:
+        consumed by the ``train_chunk`` errors (which must name the
+        exact objective/sampling/config condition that failed, not just
+        point back at the predicate) and recorded as provenance by the
+        benches (tools/bench_fused.py, bench.py extras)."""
+        cfg = self.config
+        reasons: List[str] = []
+        if type(self) is not GBDTModel:
+            reasons.append(
+                f"boosting={cfg.boosting}: DART/RF drive the iteration "
+                "loop host-side (tree weights / bias folding)")
+        if self.objective is None:
+            reasons.append(
+                "custom objective (fobj): gradients arrive from the host "
+                "every iteration")
+        else:
+            if self.objective.need_renew_tree_output:
+                reasons.append(
+                    f"objective={self.objective.name} renews leaf outputs "
+                    "host-side (RenewTreeOutput)")
+            if self.objective.host_state_per_iter:
+                reasons.append(
+                    f"objective={self.objective.name} mutates host state "
+                    "every iteration")
+        if self.num_class != 1:
+            reasons.append(
+                f"num_class={self.num_class}: multiclass grows one tree "
+                "per class per iteration through the host loop")
+        if cfg.linear_tree:
+            reasons.append("linear_tree fits per-leaf linear models "
+                           "host-side")
+        if self._learner_kind != "masked":
+            reasons.append(
+                f"tpu_learner={self._learner_kind}: only the one-program "
+                "masked grower runs inside a fused scan")
+        if self._dist is not None:
+            reasons.append(
+                f"tree_learner={self._dist}: distributed growers "
+                "re-materialize tree arrays per iteration")
+        if self._custom_hist_reduce:
+            reasons.append("caller-supplied hist_reduce hook")
+        if self._forced_spec is not None:
+            reasons.append("forced_splits need host node bookkeeping")
+        if cfg.fused_chunk <= 1:
+            reasons.append(f"fused_chunk={cfg.fused_chunk} (set > 1 to "
+                           "enable fusion)")
+        if self._faults_active():
+            reasons.append(
+                "fault injection active: host-side injection sites "
+                "cannot fire inside a fused device program")
+        return reasons
+
     def _fused_chunk_fn(self):
         fn = self._fused_cache.get("chunk")
         if fn is None:
@@ -1530,11 +1607,16 @@ class GBDTModel:
         if self._elastic is not None:
             self._elastic.check_peers()      # per-chunk liveness poll
         if self.valid_sets:
-            raise ValueError("train_chunk requires no validation sets")
+            raise ValueError(
+                "train_chunk requires no validation sets: per-iteration "
+                "eval/early-stop runs go through train_superepoch, which "
+                "evaluates traced metrics inside the scan (engine.train "
+                "routes there automatically)")
         if not self._fusable_config():
             raise ValueError(
-                "train_chunk: this model/objective/sampling configuration "
-                "is not fusable (check supports_fused() before calling)")
+                "train_chunk: config not fusable: "
+                + "; ".join(r for r in self.fused_reasons()
+                            if not r.startswith("fused_chunk=")))
         cfg = self.config
         start_iter = self.iter_
         init0 = 0.0
@@ -1663,6 +1745,593 @@ class GBDTModel:
             self._bbox.record(**rec)
         self._last_iter_state = None    # rollback not supported past a chunk
         return stopped
+
+    # -- super-epoch trainer: whole-run on-device boosting -----------------
+
+    def _se_steps(self) -> int:
+        """Static per-tree traversal budget for the in-scan valid-set
+        scoring (utils/shapes.traversal_steps): the scan cannot size a
+        fori_loop from a grown tree's ACTUAL depth (a traced value), so
+        every tree in the epoch walks the config-derived worst case."""
+        from ..utils.shapes import traversal_steps
+        cfg = self.config
+        return traversal_steps(cfg.max_depth,
+                               self._leaf_pad or max(cfg.num_leaves, 2))
+
+    def _se_valid_dev(self, vi: int) -> Tuple[jax.Array, jax.Array]:
+        """Device (label, weight) operands of valid set ``vi``, padded to
+        its bucketed score length — pad rows carry weight 0 so the traced
+        weighted metrics reduce them away exactly."""
+        cached = self._se_valid_cache.get(vi)
+        if cached is not None:
+            return cached
+        vds, _, vscore = self.valid_sets[vi]
+        rows, nv = vscore.shape[0], vds.num_data
+        lbl = np.zeros(rows, np.float32)
+        lbl[:nv] = np.asarray(vds.metadata.label, np.float32).reshape(-1)
+        w = np.zeros(rows, np.float32)
+        if vds.metadata.weight is not None:
+            w[:nv] = np.asarray(vds.metadata.weight,
+                                np.float32).reshape(-1)
+        else:
+            w[:nv] = 1.0
+        out = (jnp.asarray(lbl), jnp.asarray(w))
+        self._se_valid_cache[vi] = out
+        return out
+
+    def _teval_fn(self, eval_spec):
+        """The shared traced-eval program for ``eval_spec`` (model-level
+        cache; metrics.build_traced_eval).  Both the super-epoch replay
+        rows and Booster.eval_valid_traced report through THIS program,
+        which is what makes their values bit-identical."""
+        key = ("teval", tuple(eval_spec))
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            from ..metrics import build_traced_eval
+            fn = build_traced_eval(tuple(eval_spec), self.config)
+            self._fused_cache[key] = fn
+        return fn
+
+    def _obj_array_attrs(self):
+        """Partition the live objective's attributes into (array attr
+        names, array values, scalar key parts) so the super-epoch program
+        can bake a data-free objective template and receive the arrays as
+        ARGUMENTS (process-level program sharing).  Returns None when an
+        attribute defies classification — the caller then falls back to a
+        private jit that closes over the objective whole."""
+        names: List[str] = []
+        vals: List[jax.Array] = []
+        scal: List[Tuple[str, str]] = []
+        for name in sorted(vars(self.objective)):
+            if name == "config":
+                continue            # keyed via Config.to_dict already
+            v = getattr(self.objective, name)
+            if isinstance(v, (jax.Array, np.ndarray)):
+                names.append(name)
+                vals.append(jnp.asarray(v))
+            elif v is None or isinstance(v, (bool, int, float, str)):
+                scal.append((name, repr(v)))
+            elif isinstance(v, tuple) and all(
+                    isinstance(t, (bool, int, float, str)) for t in v):
+                scal.append((name, repr(v)))
+            else:
+                return None
+        return tuple(names), tuple(vals), tuple(scal)
+
+    def _superepoch_key(self, eval_spec, es_spec, obj_parts):
+        """Process-level sharing key for the super-epoch program, or None
+        when this model's state cannot ride as arguments (private jit in
+        ``self._fused_cache`` instead).  ``num_leaves`` is deliberately
+        REPLACED by the effective super-step width when the leaf budget
+        is padded: with ``padded_leaves`` the budget is a traced argument
+        and the only structural residue of ``num_leaves`` is the grower's
+        K = min(split_batch, num_leaves - 1) — so a 31/63 leaf sweep at
+        split_batch <= 30 shares ONE compiled scan (the check_retraces.py
+        ``superepoch`` scenario pins exactly that)."""
+        cfg = self.config
+        if obj_parts is None:
+            return None
+        if (self._use_efb or self.efb_maps is not None
+                or self._ic_grow is not None
+                or self._cegb_state is not None
+                or self._mono is not None or self._inter is not None
+                or self._feature_contri is not None or self._pc > 1):
+            return None
+        if self._goss or self._bagging_active:
+            return None     # sampling bakes bound methods (model state)
+        from ..sparse_data import SparseBinned
+        if isinstance(self.binned_dev, SparseBinned) or any(
+                not isinstance(vb, jax.Array)
+                for _, vb, _ in self.valid_sets):
+            return None
+        cfg_items = tuple(sorted(
+            (k, repr(v)) for k, v in cfg.to_dict().items()
+            if k != "num_leaves" or self._leaf_pad is None))
+        k_eff = max(1, min(self._split_batch, cfg.num_leaves - 1)) \
+            if cfg.num_leaves > 1 else 1
+        names, _, scal = obj_parts
+        return (cfg_items, k_eff, self._split_batch, self._block_rows,
+                self._leaf_pad, self._hist_overlap, self._learner_kind,
+                self._se_steps(), float(self.learning_rate), self.max_bin,
+                type(self.objective).__name__, names, scal,
+                len(self.valid_sets), tuple(eval_spec), repr(es_spec))
+
+    def _build_superepoch(self, eval_spec, es_spec, obj_parts):
+        """Compile the super-epoch program: ONE ``lax.scan`` over k FULL
+        boosting iterations — gradients, grow, score update, valid-set
+        traversal+scoring, traced metric eval, early-stop vote — with
+        zero host syncs inside.  The per-iteration tree math is the
+        fused-chunk ``one_iter`` body verbatim (same RNG streams, same
+        finite-guard policies, same dead-gating), extended with the
+        traced eval tail; model data arrays ride as arguments so keyable
+        configs share the compile process-wide (``_SE_CACHE``)."""
+        import functools
+        from ..metrics import traced_metric_fn
+        from ..obs.flops import (eval_flops_bytes, note_traced,
+                                 score_update_flops_bytes)
+        from ..utils.compile_cache import trace_event
+
+        cfg = self.config
+        grow = make_grower(
+            num_leaves=cfg.num_leaves, num_bins=self.max_bin,
+            params=self.split_params, max_depth=cfg.max_depth,
+            block_rows=self._block_rows,
+            efb=self.efb_dev if self._use_efb else None,
+            gain_scale=self._feature_contri,
+            extra_trees=self._extra_trees, extra_seed=cfg.extra_seed,
+            split_batch=self._split_batch,
+            hist_overlap=self._hist_overlap,
+            mono=self._mono if self._learner_kind == "masked" else None,
+            mono_penalty=cfg.monotone_penalty,
+            interaction_groups=self._inter,
+            bynode_frac=cfg.feature_fraction_bynode,
+            bynode_seed=cfg.feature_fraction_seed + 1,
+            cegb=self._cegb_state,
+            padded_leaves=self._leaf_pad,
+            quant=self._quant,
+            jit=False)
+        if obj_parts is not None:
+            arr_names = obj_parts[0]
+            obj_template = copy.copy(self.objective)
+            for nm in arr_names:
+                setattr(obj_template, nm, None)   # arrays ride as args
+        else:
+            arr_names = ()
+            obj_template = self.objective      # private jit: close over
+        lr = jnp.float32(self.learning_rate)
+        use_goss = self._goss
+        use_bag = self._bagging_active and not use_goss
+        # bound methods hold the model alive — only bake them when the
+        # sampling mode actually uses them (sampling also excludes the
+        # model from _SE_CACHE sharing, so a baked method never leaks
+        # into another model's program)
+        goss_vals = self._goss_vals if use_goss else None
+        bagging_w = self._bagging_w if use_bag else None
+        rng_iter_kw = (self._extra_trees or self._bynode_masked
+                       or self._quant is not None)
+        ic = self._ic_grow
+        fin_freq = cfg.finite_check_freq
+        fin_policy = cfg.finite_check_policy
+        use_cegb = self._cegb_state is not None
+        nf = self.num_features
+        leaf_padded = self._leaf_pad is not None
+        steps = self._se_steps()
+        efb_maps = self.efb_maps
+        n_rows = self.num_data
+
+        # eval plumbing: one traced metric per (valid set, metric) entry,
+        # in booster.eval_valid() order.  The in-scan eval exists ONLY
+        # to drive the early-stop vote (callback.early_stopping's
+        # update-then-check at min_delta == 0): reported values are
+        # recomputed post-scan through the shared teval program
+        # (metrics.build_traced_eval) from the stacked per-iteration
+        # valid scores the scan emits, because a reduction fused INTO
+        # the scan body may round the last ulp differently than the
+        # standalone program — bit-identity with the per-iteration
+        # fused_eval path requires the same program shape
+        n_entries = len(eval_spec)
+        vote_eval = es_spec is not None and n_entries > 0
+        metric_idx = tuple(
+            (vi, traced_metric_fn(mname, cfg))
+            for (vi, _sname, mname, _hib) in eval_spec) if vote_eval \
+            else ()
+        if es_spec is not None:
+            es_rounds = int(es_spec["stopping_rounds"])
+            es_elig = jnp.asarray(np.asarray(es_spec["eligible"], bool))
+            es_hib = jnp.asarray(
+                np.asarray([hib for (_, _, _, hib) in eval_spec], bool))
+
+        # the scan body is defined inside the jitted wrapper because the
+        # objective must first be assembled from the array arguments
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def sepoch(score, vscores, es_state, fmasks, iters, eiters,
+                   cuse0, ml, binned, nb, na, na_bin, obj_arrs,
+                   valid_ops):
+            trace_event("superepoch")
+            obj = copy.copy(obj_template)
+            for nm, arr in zip(arr_names, obj_arrs):
+                setattr(obj, nm, arr)
+
+            def one_iter(carry, xs):
+                score, vsc, esb, esi, esh, stop, dead, cuse, ml = carry
+                fmask, it, eit = xs
+                blocked = dead | stop
+                g, h = obj.get_gradients(score[:, 0])
+                if fin_freq > 0 and fin_policy == "clamp":
+                    g = jnp.nan_to_num(g, nan=0.0, posinf=_FINITE_CLAMP,
+                                       neginf=-_FINITE_CLAMP)
+                    h = jnp.nan_to_num(h, nan=0.0, posinf=_FINITE_CLAMP,
+                                       neginf=0.0)
+                if use_goss:
+                    w = goss_vals(g, h, it)
+                elif use_bag:
+                    w = bagging_w(it)
+                else:
+                    w = jnp.ones_like(g)
+                vals = jnp.stack([g * w, h * w, w], axis=1)
+                kw = {"is_cat": ic} if ic is not None else {}
+                if rng_iter_kw:
+                    kw["rng_iter"] = it
+                if use_cegb:
+                    kw["cegb_used"] = cuse
+                if leaf_padded:
+                    kw["max_leaves"] = ml
+                arrays = grow(binned, vals, fmask, nb, na, **kw)
+                if use_cegb:
+                    node_on = (jnp.arange(arrays.split_feature.shape[0])
+                               < arrays.num_leaves - 1)
+                    marks = jnp.zeros(nf, jnp.int32) \
+                        .at[arrays.split_feature].add(
+                            node_on.astype(jnp.int32))
+                    cuse = cuse | (marks > 0)
+                if fin_freq > 0 and fin_policy == "clamp":
+                    lv = jnp.nan_to_num(
+                        arrays.leaf_value, nan=0.0, posinf=_FINITE_CLAMP,
+                        neginf=-_FINITE_CLAMP) * lr
+                else:
+                    lv = arrays.leaf_value * lr
+                if fin_freq > 0 and fin_policy != "clamp":
+                    check_now = ((it + 1) % fin_freq) == 0
+                    fin = (jnp.isfinite(g).all() & jnp.isfinite(h).all()
+                           & jnp.isfinite(lv).all())
+                    bad = check_now & ~fin
+                else:
+                    bad = jnp.bool_(False)
+                ok = jnp.where(blocked | bad, 0.0,
+                               (arrays.num_leaves > 1)
+                               .astype(jnp.float32))
+                if fin_freq > 0 and fin_policy == "raise":
+                    dead = dead | (arrays.num_leaves <= 1) | bad
+                else:
+                    dead = dead | ((arrays.num_leaves <= 1) & ~bad)
+                delta = jnp.where(ok > 0.0,
+                                  jnp.take(lv, arrays.leaf_of_row), 0.0)
+                note_traced("score",
+                            *score_update_flops_bytes(score.shape[0]),
+                            phase="score", cadence="iter")
+                score = score.at[:, 0].add(delta)
+                if fin_freq > 0 and fin_policy == "skip_iter":
+                    score = jnp.where(bad, jnp.nan_to_num(
+                        score, nan=0.0, posinf=_FINITE_CLAMP,
+                        neginf=-_FINITE_CLAMP), score)
+                # valid-set scoring: same traversal + leaf-gather the
+                # per-iteration path runs (predict_device add_tree_score
+                # at weight 1.0 == plain gather-add), under ONE static
+                # step budget so every tree of the epoch shares the trace
+                new_vsc = []
+                for vi2 in range(len(valid_ops)):
+                    leaf = traverse_tree_binned(
+                        valid_ops[vi2][0], arrays.split_feature,
+                        arrays.threshold_bin, arrays.default_left,
+                        arrays.left_child, arrays.right_child, na_bin,
+                        arrays.is_cat_node, arrays.cat_rank, efb_maps,
+                        steps=steps)
+                    vd = jnp.where(ok > 0.0, jnp.take(lv, leaf), 0.0)
+                    new_vsc.append(vsc[vi2].at[:, 0].add(vd))
+                vsc = tuple(new_vsc)
+                # early-stop vote (callback.early_stopping traced form,
+                # min_delta == 0): update-then-check exactly like the
+                # host closure — non-eligible entries (training set /
+                # first_metric_only filter) still update their best.
+                # The vote's in-scan metric values may differ from the
+                # reported teval values in the last ulp (fusion-order);
+                # engine.train heals vote/replay disagreement either
+                # way (drop_iterations / clear_es_stop), so the vote is
+                # a work-bound, never the source of truth
+                if vote_eval:
+                    note_traced("fused_eval",
+                                *eval_flops_bytes(n_rows, n_entries),
+                                phase="eval", cadence="iter")
+                    ev = jnp.stack([
+                        fn_m(vsc[vi2][:, 0], valid_ops[vi2][1],
+                             valid_ops[vi2][2])
+                        for (vi2, fn_m) in metric_idx])
+                    fin2 = jnp.isfinite(ev)
+                    cmp2 = jnp.where(es_hib, ev > esb, ev < esb)
+                    improved = fin2 & (~esh | cmp2) & ~blocked
+                    esb = jnp.where(improved, ev, esb)
+                    esi = jnp.where(improved, eit, esi)
+                    esh = esh | improved
+                    trip = (es_elig & ((eit - esi) >= es_rounds)
+                            & ~blocked)
+                    stop = stop | trip.any()
+                out = arrays._replace(
+                    leaf_of_row=jnp.zeros((), jnp.int32), leaf_value=lv)
+                return ((score, vsc, esb, esi, esh, stop, dead, cuse,
+                         ml), (out, bad, stop,
+                               tuple(v[:, 0] for v in vsc)))
+
+            esb, esi, esh, stop = es_state
+            carry0 = (score, vscores, esb, esi, esh, stop,
+                      jnp.bool_(False), cuse0, ml)
+            (score, vscores, esb, esi, esh, stop, _, _, _), \
+                (out, bad, stops, vstack) = jax.lax.scan(
+                    one_iter, carry0, (fmasks, iters, eiters))
+            return (score, vscores, (esb, esi, esh, stop), out, bad,
+                    stops, vstack)
+
+        return sepoch
+
+    def train_superepoch(self, k: int, es_it0: int, eval_spec=(),
+                         es_spec=None) -> dict:
+        """Run ``k`` FULL boosting iterations — grow, score update,
+        valid-set scoring, traced metric eval and the early-stop vote —
+        as ONE device program with exactly ONE host fetch (stacked tree
+        records + finite-guard flags + the [k, E] eval block + per-
+        iteration stop flags).  ``engine.train`` replays the fetched
+        block through the real host callbacks afterwards, so
+        ``record_evals``/``early_stopping``/``best_iteration`` are
+        byte-identical to the per-iteration path.
+
+        ``es_it0`` is the absolute ``env.iteration`` of the epoch's
+        first row (the PR 9 absolute best_iteration contract —
+        resume-correct); ``eval_spec`` is a tuple of
+        ``(valid_idx, set_name, metric_name, higher_better)`` entries in
+        ``booster.eval_valid()`` order; ``es_spec`` (optional) is
+        ``{"stopping_rounds", "first_metric_only", "eligible"}`` for the
+        traced vote (scalar ``min_delta == 0`` only — engine gates).
+
+        Returns ``{"evals": f32 [done, E], "done": int, "stump": bool,
+        "stop_row": Optional[int]}``."""
+        if self._elastic is not None:
+            self._elastic.check_peers()
+        if not self._fusable_config():
+            raise ValueError(
+                "train_superepoch: config not fusable: "
+                + "; ".join(r for r in self.fused_reasons()
+                            if not r.startswith("fused_chunk=")))
+        cfg = self.config
+        start_iter = self.iter_
+        init0 = 0.0
+        if start_iter == 0 and self.objective is not None \
+                and cfg.boost_from_average and not self._init_applied:
+            init0 = self._boost_from_score(0)
+            self._init_scores = [init0]
+            if init0 != 0.0:
+                self.score = self.score + jnp.float32(init0)
+                # valid scores carry the same bias (train_one_iter's
+                # boost_from path does this per-set too)
+                for vi in range(len(self.valid_sets)):
+                    vds, vb, vs = self.valid_sets[vi]
+                    self.valid_sets[vi] = (vds, vb,
+                                           vs + jnp.float32(init0))
+
+        obs = self._obs
+        if obs is not None:
+            _sp = obs.tracer.span("train_superepoch", n_iters=k,
+                                  iteration=start_iter,
+                                  n_evals=len(eval_spec))
+            if obs.profiler is not None:
+                for it in range(start_iter, start_iter + k):
+                    obs.profiler.on_iter_begin(it)
+
+        obj_parts = self._obj_array_attrs()
+        key = self._superepoch_key(eval_spec, es_spec, obj_parts)
+        fn = None
+        if key is not None:
+            with _SE_CACHE_LOCK:
+                fn = _SE_CACHE.get(key)
+                if fn is not None:
+                    _SE_CACHE.move_to_end(key)
+            if fn is None:
+                fn = self._build_superepoch(eval_spec, es_spec, obj_parts)
+                with _SE_CACHE_LOCK:
+                    _SE_CACHE[key] = fn
+                    while len(_SE_CACHE) > _SE_CACHE_MAX:
+                        _SE_CACHE.popitem(last=False)
+        else:
+            pk = ("superepoch", tuple(eval_spec), repr(es_spec))
+            fn = self._fused_cache.get(pk)
+            if fn is None:
+                fn = self._build_superepoch(eval_spec, es_spec, obj_parts)
+                self._fused_cache[pk] = fn
+
+        if cfg.feature_fraction < 1.0:
+            fmasks = jnp.asarray(
+                np.stack([self._feature_mask() for _ in range(k)]))
+        else:
+            fmasks = jnp.ones((k, self.num_features), bool)
+        it0 = start_iter + self._iter_rng_offset
+        iters = jnp.arange(it0, it0 + k, dtype=jnp.int32)
+        eiters = jnp.arange(es_it0, es_it0 + k, dtype=jnp.int32)
+        cuse0 = jnp.asarray(self._cegb_state.used) \
+            if self._cegb_state is not None \
+            else jnp.zeros(1, bool)
+        E = len(eval_spec)
+        es_state = self._es_dev
+        if es_state is None:
+            es_state = (jnp.zeros(E, jnp.float32),
+                        jnp.zeros(E, jnp.int32), jnp.zeros(E, bool),
+                        jnp.bool_(False))
+        vscores = tuple(vs for _, _, vs in self.valid_sets)
+        valid_ops = tuple(
+            (self.valid_sets[vi][1],) + self._se_valid_dev(vi)
+            for vi in range(len(self.valid_sets)))
+        obj_arrs = obj_parts[1] if obj_parts is not None else ()
+        (self.score, new_vsc, es_out, stacked, bad_flags, stops_dev,
+         vstack) = fn(self.score, vscores, es_state, fmasks, iters,
+                      eiters, cuse0, jnp.int32(cfg.num_leaves),
+                      self.binned_dev, self._nb_grow, self._na_grow,
+                      self.na_bin_dev, obj_arrs, valid_ops)
+        for vi in range(len(self.valid_sets)):
+            vds, vb, _ = self.valid_sets[vi]
+            self.valid_sets[vi] = (vds, vb, new_vsc[vi])
+        self._es_dev = es_out
+        # reported eval values: the SAME jitted program the per-iteration
+        # fused_eval path runs (metrics.build_traced_eval), applied to
+        # each iteration's stacked valid-score row — in-scan reductions
+        # can fuse (and round the last ulp) differently than the
+        # standalone program, so re-evaluating through the shared program
+        # is what makes super-epoch record_evals bit-identical to
+        # per-iteration.  The k dispatches are async; no host sync here
+        if E:
+            teval = self._teval_fn(eval_spec)
+            t_ops = tuple(self._se_valid_dev(vi)
+                          for vi in range(len(self.valid_sets)))
+            ev_dev = jnp.stack([
+                teval(tuple(vstack[vi][j]
+                            for vi in range(len(vstack))), t_ops)
+                for j in range(k)])
+        else:
+            ev_dev = jnp.zeros((k, 0), jnp.float32)
+        # the one sync per super-epoch (tree records + finite-guard
+        # flags + eval block + stop flags)
+        host, bad_host, ev_host, stops_np = self._eget(
+            (stacked, bad_flags, ev_dev, stops_dev), "fused_fetch")
+        if obs is not None:
+            _sp.end()
+            if obs.profiler is not None:
+                obs.profiler.on_iter_end(start_iter + k - 1)
+
+        lr = self.learning_rate
+        stopped = False
+        stop_row = None
+        for j in range(k):
+            tj = TreeArrays(*(np.asarray(fld[j]) for fld in host))
+            nl = int(tj.num_leaves)
+            if bool(bad_host[j]):
+                from ..utils.log import Log
+                msg = ("non-finite gradient/hessian or leaf output "
+                       f"detected at iteration {it0 + j + 1} "
+                       f"(finite_check_freq={cfg.finite_check_freq})")
+                if self._bbox is not None:
+                    self._bbox.record(event="finite_check_trip",
+                                      iteration=it0 + j + 1,
+                                      policy=cfg.finite_check_policy,
+                                      fused=True)
+                    self._bbox.dump("finite_check")
+                if cfg.finite_check_policy == "raise":
+                    from ..basic import LightGBMError
+                    raise LightGBMError(
+                        msg + "; aborting (finite_check_policy=raise)")
+                Log.warning(msg + "; iteration contributes nothing "
+                                  "(finite_check_policy=skip_iter)")
+                self.step_counts.append(int(tj.n_steps))
+                ht = Tree(1)
+                ht.shrinkage = lr
+                ht.leaf_value = np.asarray(
+                    [init0 if (start_iter == 0 and j == 0) else 0.0],
+                    np.float64)
+                self.models.append(ht)
+                dev_arrays = TreeArrays(*(fld[j] for fld in stacked))
+                self.device_trees.append(_DeviceTree(
+                    dev_arrays, jnp.zeros_like(dev_arrays.leaf_value),
+                    1))
+                self.tree_weights.append(1.0)
+                self.iter_ += 1
+                if bool(stops_np[j]):
+                    stop_row = j
+                    break
+                continue
+            self.step_counts.append(int(tj.n_steps))
+            lvj = np.asarray(tj.leaf_value, np.float64).copy()
+            if self._cegb_state is not None and nl > 1:
+                self._cegb_state.used[
+                    np.asarray(tj.split_feature)[:nl - 1]] = True
+            if nl <= 1:
+                stopped = True
+                lvj[:] = 0.0
+            ht = Tree.from_arrays(tj, self.train_set.used_features,
+                                  self.train_set.bin_mappers)
+            ht.internal_value = ht.internal_value * lr
+            ht.shrinkage = lr
+            bias = init0 if (start_iter == 0 and j == 0) else 0.0
+            ht.leaf_value = lvj[:max(nl, 1)] + bias
+            self.models.append(ht)
+
+            dev_arrays = TreeArrays(*(fld[j] for fld in stacked))
+            dev_lv = dev_arrays.leaf_value if nl > 1 else \
+                jnp.zeros_like(dev_arrays.leaf_value)
+            steps = round_up_pow2(max(ht.max_depth(), 1))
+            self.device_trees.append(
+                _DeviceTree(dev_arrays, dev_lv, steps))
+            self.tree_weights.append(1.0)
+            self.iter_ += 1
+            if stopped or bool(stops_np[j]):
+                if bool(stops_np[j]):
+                    stop_row = j
+                break
+        done = self.iter_ - start_iter
+        if obs is not None:
+            obs.metrics.counter("train.iterations").inc(done)
+            obs.metrics.counter("train.superepochs").inc()
+            for s in self.step_counts[len(self.step_counts) - done:]:
+                obs.metrics.histogram("train.steps_per_tree").observe(s)
+                obs.record_flops(s)
+        if self._bbox is not None:
+            rec = {"event": "superepoch", "iterations": done,
+                   "first_iteration": start_iter + 1,
+                   "n_evals": E,
+                   "steps": self.step_counts[len(self.step_counts)
+                                             - done:]}
+            if self._flops is not None:
+                fl = hb = 0
+                for s in rec["steps"]:
+                    f_, b_ = self._flops.per_iteration(s)
+                    fl, hb = fl + f_, hb + b_
+                rec["flops"], rec["hbm_bytes"] = fl, hb
+            self._bbox.record(**rec)
+        self._last_iter_state = None
+        return {"evals": np.asarray(ev_host, np.float32).reshape(k, E),
+                "done": done, "stump": stopped, "stop_row": stop_row}
+
+    def drop_iterations(self, n: int) -> None:
+        """Host-slice the last ``n`` recorded iterations.  Super-epoch
+        replay healing only: when the host callback replay stops earlier
+        than the traced vote predicted (defensive — the vote consumes
+        the same fetched values the replay does), training is over and
+        the surplus trees must not appear in the saved model.  Scores
+        are rebuilt by subtracting each dropped tree's contribution via
+        device traversal (float add-then-subtract: not bit-perfect, but
+        this path ends training — nothing trains on the healed score)."""
+        n = int(n)
+        if n <= 0:
+            return
+        nt = n * self.num_class
+        for dt in self.device_trees[-nt:]:
+            self.score = self.score.at[:, 0].add(
+                -jnp.take(dt.leaf_value,
+                          _tree_leaves(self.binned_dev, dt,
+                                       self.na_bin_dev, self.efb_maps)))
+            for vi in range(len(self.valid_sets)):
+                vds, vb, vs = self.valid_sets[vi]
+                vd = _apply_tree(jnp.zeros_like(vs[:, 0]), vb, dt,
+                                 self.na_bin_dev, 1.0, self.efb_maps)
+                self.valid_sets[vi] = (vds, vb, vs.at[:, 0].add(-vd))
+        del self.models[-nt:]
+        del self.device_trees[-nt:]
+        del self.tree_weights[-nt:]
+        del self.step_counts[-nt:]
+        self.iter_ -= n
+        self._last_iter_state = None
+
+    def clear_es_stop(self) -> None:
+        """Reset the traced early-stop vote's stop latch (defensive
+        counterpart of drop_iterations: the vote tripped but the host
+        replay did not raise — trust the host and keep training)."""
+        if self._es_dev is not None:
+            esb, esi, esh, _ = self._es_dev
+            self._es_dev = (esb, esi, esh, jnp.bool_(False))
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:

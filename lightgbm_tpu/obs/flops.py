@@ -203,6 +203,16 @@ def score_update_flops_bytes(n_rows: int) -> Tuple[int, int]:
     return 2 * n, n * 4 + 2 * n * 4
 
 
+def eval_flops_bytes(n_rows: int, n_entries: int) -> Tuple[int, int]:
+    """Traced in-scan metric evaluation (metrics.traced_metric_fn,
+    models/gbdt.py train_superepoch): ~8 ops per (valid row, metric
+    entry) — transform, clip, weight, pad-mask, reduce — charged against
+    the TRAIN row count as a conservative stand-in (valid sets are
+    usually smaller).  Bytes: score/label/weight reads per entry."""
+    n = int(n_rows) * max(int(n_entries), 1)
+    return 8 * n, 3 * 4 * n
+
+
 # per (row, tree, level) ops of the binned traversal: node gather,
 # feature gather, bin gather, NaN test, rank gather, compare,
 # child select, finished-row select

@@ -137,6 +137,21 @@ def bucket_steps(depth: int, floor: int = 8) -> int:
     return _pow2_floor(depth, floor)
 
 
+def traversal_steps(max_depth: int, leaf_budget: int) -> int:
+    """Static per-tree traversal step budget for the fused super-epoch
+    (models/gbdt.py train_superepoch): the in-scan valid-set traversal
+    cannot size its fori_loop from the grown tree's ACTUAL depth (a
+    traced value), so it walks a config-derived worst case — max_depth
+    when bounded, else ``leaf_budget - 1`` (a leaf-wise tree with L
+    leaves is at most L-1 deep).  Finished rows carry their leaf id
+    unchanged through the surplus levels
+    (predict_device.traverse_tree_binned), so padding costs cycles
+    only, never numerics; bounding max_depth is the perf lever when
+    the leaf budget is large."""
+    cap = int(max_depth) if int(max_depth) > 0 else max(int(leaf_budget) - 1, 1)
+    return round_up_pow2(max(cap, 1))
+
+
 def bucket_channels(c: int) -> int:
     """Padded histogram-contraction channel width for a slot-expanded
     C = cv·K axis: exact up to ``HIST_CHANNEL_EXACT_MAX`` (the shipped

@@ -55,7 +55,8 @@ BUDGET = os.path.join(REPO, "tools", "perf_budget.txt")
 # metrics gate downward, everything else (rates, MFU) upward
 _LOWER_BETTER = re.compile(
     r"(_ms|compile_s|_seconds|_lag_s|_gen_s|_hbm_bytes_per_iter"
-    r"|_ms_per_pass|_ms_per_leaf(_k\d+|_wide)?)$")
+    r"|_ms_per_pass|_ms_per_leaf(_k\d+|_wide)?"
+    r"|_sync(s|_count)_per_iter)$")
 # extras worth gating by default: primary value, throughput points,
 # serve latency/throughput (host-accumulation AND fused device paths),
 # mfu, the continual pipeline's freshness numbers, and the histogram
@@ -67,6 +68,11 @@ _GATEABLE = re.compile(
     r"|^hist_hbm_bytes_per_iter$"
     r"|^hist_ms_per_(pass|leaf_k\d+|leaf_wide)$"
     r"|^hist_quant_q(off|8|16)_k\d+_ms_per_(pass|leaf)$"
+    # super-epoch sweep (ISSUE 16, tools/bench_fused.sweep): headline
+    # throughput + the structural syncs-per-iter count (1/k), plus the
+    # per-k sweep keys
+    r"|^superepoch_(iters_per_s|sync_count_per_iter"
+    r"|k\d+_(valid|novalid)_(iters_per_s|syncs_per_iter))$"
     r"|^continual_(freshness_lag_s|gen_s)$)")
 _DEFAULT_TOL = {"higher": 0.20, "lower": 0.30}
 

@@ -153,6 +153,19 @@ def run_matrix() -> Dict[str, int]:
         for nl in (31, 40):
             _train(lgb, x, y, num_leaves=nl, fused_chunk=2)
 
+    # 4b. super-epoch scan (ISSUE 16): a num_leaves sweep at k=8 with a
+    #    valid set + traced metric stays ONE scan trace — the leaf
+    #    budget pads 31/63 onto L=64 and `_superepoch_key` carries only
+    #    bucketed shapes, so the whole-run scan (k grows + k traced
+    #    evals + the ES vote) compiles once per bucket, not per config.
+    #    split_batch is pinned so the grower width doesn't fork the key.
+    with _Scope("superepoch", measured):
+        for nl in (31, 63):
+            _train(lgb, x, y, rounds=8, num_leaves=nl, superepoch=8,
+                   fused_chunk=8, split_batch=1,
+                   valid=[(x[:200], y[:200])],
+                   metric=["binary_logloss"])
+
     # 5. serve batch mix: pow2-bucketed engine bounds forest traces
     with _Scope("serve_buckets", measured):
         from lightgbm_tpu.serve.engine import PredictorEngine
