@@ -573,6 +573,58 @@ def child_extras() -> None:
     except Exception as e:
         _record_point("superepoch", error=f"{type(e).__name__}: {e}"[:200])
 
+    # out-of-core ingest microbench (ISSUE 17, lightgbm_tpu/ingest.py):
+    # streaming rows/s through the chunked reader + quantile sketcher,
+    # peak RSS of a SUBPROCESS ingesting a many-chunk file (the
+    # bounded-memory claim: one chunk in flight regardless of chunk
+    # count — gated lower-better in tools/perf_budget.txt), and the
+    # serialized-sketch allgather wire bytes from parallel/dist_data.py
+    # (what crosses the fleet instead of raw sample rows).  Keyed
+    # points: fold as ingest_rows_per_s / ingest_peak_rss_mb /
+    # binning_wire_bytes
+    try:
+        import tempfile
+        n_i, f_i = (40_000, 8) if cpu else (200_000, 8)
+        tmpd = tempfile.mkdtemp(prefix="bench_ingest_")
+        src = os.path.join(tmpd, "train.csv")
+        rng = np.random.RandomState(11)
+        xi = np.round(rng.randn(n_i, f_i), 3)
+        yi = (xi[:, 0] > 0).astype(np.float64)
+        np.savetxt(src, np.column_stack([yi, xi]), fmt="%.3f",
+                   delimiter=",")
+        child = (
+            "import sys,json,time,resource;"
+            f"sys.path.insert(0,{_DIR!r});"
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import lightgbm_tpu as lgb;"
+            f"p={{'verbosity':-1,'ingest_chunk_rows':{max(n_i // 64, 1)}}};"
+            "t0=time.time();"
+            f"ds=lgb.ingest_dataset({src!r},p,"
+            f"spool_dir={os.path.join(tmpd, 'spool')!r});"
+            "dt=time.time()-t0;"
+            "print(json.dumps({"
+            "'rows_per_s':ds.ingest_report['num_rows']/max(dt,1e-9),"
+            "'peak_rss_mb':resource.getrusage("
+            "resource.RUSAGE_SELF).ru_maxrss/1024.0}))")
+        out = subprocess.run([sys.executable, "-c", child],
+                             capture_output=True, text=True, timeout=600)
+        ip = json.loads(out.stdout.strip().splitlines()[-1])
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.parallel import dist_data
+        cfg_i = Config({"max_bin": PRIMARY_MAX_BIN, "verbosity": -1})
+        dist_data.reset_wire_bytes()
+        dist_data.distributed_bin_mappers(
+            xi[:20_000], cfg_i, process_index=0, process_count=1,
+            allgather=lambda b: [b])
+        _record_point("ingest", cpu=cpu,
+                      rows_per_s=round(ip["rows_per_s"], 1),
+                      peak_rss_mb=round(ip["peak_rss_mb"], 1),
+                      chunk_rows=max(n_i // 64, 1))
+        _record_point("binning", cpu=cpu,
+                      wire_bytes=dist_data.wire_bytes_sent())
+    except Exception as e:
+        _record_point("ingest", error=f"{type(e).__name__}: {e}"[:200])
+
     # comm wire bytes per boosting iteration (obs/comm.py static model,
     # same math the telemetry counters use at train time): the in-flight
     # number arXiv:1706.08359 instruments to validate scaling — one

@@ -18,6 +18,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .dataset import Dataset, Sequence
 from .engine import CVBooster, cv, train
+from .ingest import IngestRunner, ingest_dataset
 from .pipeline import ContinualTrainer, GateFailure
 from .plotting import (create_tree_digraph, plot_importance, plot_metric,
                        plot_split_value_histogram, plot_tree)
@@ -27,8 +28,8 @@ from .utils.log import register_logger
 __all__ = [
     "BinMapper", "BinType", "MissingType", "Booster", "Config",
     "ContinualTrainer", "CVBooster",
-    "Dataset", "EarlyStopException", "GateFailure", "LightGBMError",
-    "Sequence", "cv",
+    "Dataset", "EarlyStopException", "GateFailure", "IngestRunner",
+    "LightGBMError", "Sequence", "cv", "ingest_dataset",
     "early_stopping", "log_evaluation", "log_telemetry",
     "record_evaluation", "reset_parameter", "train",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",

@@ -263,6 +263,24 @@ class BinMapper:
         elif len(distinct) == 0 and n_implicit_zero > 0:
             distinct, counts = np.array([0.0]), np.array([n_implicit_zero])
 
+        self._fit_numerical_from_distinct(
+            distinct, counts, na_cnt, max_bin, min_data_in_bin,
+            min_split_data, pre_filter, forced_bounds)
+
+    def _fit_numerical_from_distinct(
+            self, distinct: np.ndarray, counts: np.ndarray, na_cnt: int,
+            max_bin: int, min_data_in_bin: int, min_split_data: int = 0,
+            pre_filter: bool = False,
+            forced_bounds: Optional[Sequence[float]] = None) -> None:
+        """The numerical FindBin tail shared by the raw-values path above
+        and the streaming sketch path (:meth:`find_bin_from_sketch`):
+        ``distinct``/``counts`` are the sorted distinct non-NaN values
+        (|v| <= kZeroThreshold already collapsed to 0.0, implicit zeros
+        already merged) with their sample counts.  ``self.missing_type``
+        must already be decided by the caller."""
+        distinct = np.asarray(distinct, dtype=np.float64)
+        counts = np.asarray(counts)
+        zero_cnt = int(counts[distinct == 0.0].sum()) if len(distinct) else 0
         budget = max_bin - 1 if self.missing_type == MissingType.NAN else max_bin
         budget = max(budget, 2) if len(distinct) > 1 else max(budget, 1)
         total_non_na = int(counts.sum())
@@ -316,14 +334,21 @@ class BinMapper:
 
     def _find_bin_categorical(self, vals: np.ndarray, total_sample_cnt: int,
                               max_bin: int, min_data_in_bin: int) -> None:
-        self.bin_type = BinType.CATEGORICAL
         cats = vals.astype(np.int64)
         cats = cats[cats >= 0]  # negative categoricals treated as missing (bin.cpp warns)
-        if len(cats) == 0:
+        uniq, counts = np.unique(cats, return_counts=True)
+        self._fit_categorical_from_distinct(uniq, counts, max_bin)
+
+    def _fit_categorical_from_distinct(self, uniq: np.ndarray,
+                                       counts: np.ndarray,
+                                       max_bin: int) -> None:
+        """Categorical FindBin tail over distinct non-negative categories
+        and their counts (shared with the sketch path)."""
+        self.bin_type = BinType.CATEGORICAL
+        if len(uniq) == 0:
             self.num_bin = 1
             self.is_trivial = True
             return
-        uniq, counts = np.unique(cats, return_counts=True)
         order = np.argsort(-counts, kind="stable")  # count-sorted, most frequent first
         uniq, counts = uniq[order], counts[order]
         # drop overly rare cats beyond the bin budget (rare -> unseen at split)
@@ -336,6 +361,41 @@ class BinMapper:
         self.is_trivial = len(uniq) <= 1
         self.most_freq_bin = 0
         self.default_bin = self._cat_to_bin.get(0, 0)
+
+    def find_bin_from_sketch(self, sketch: "QuantileSketch", max_bin: int,
+                             min_data_in_bin: int, min_split_data: int = 0,
+                             pre_filter: bool = False,
+                             use_missing: bool = True,
+                             zero_as_missing: bool = False,
+                             forced_bounds: Optional[Sequence[float]] = None
+                             ) -> None:
+        """Fit the mapping from a streaming :class:`QuantileSketch`
+        instead of materialized raw values — the one-pass out-of-core
+        binning path (lightgbm_tpu/ingest.py) and the distributed
+        sketch-allgather path (parallel/dist_data.py).
+
+        Equivalence contract (docs/Ingest.md): while the sketch never
+        compacted (``sketch.compactions == 0`` — every distinct value
+        retained, the dense small-bin regime) the fitted bounds are
+        BYTE-IDENTICAL to :meth:`find_bin` over the same rows; after
+        compaction each greedy boundary's rank displacement is bounded
+        by the sketch's rank-error bound (~2·n/capacity rows per
+        compaction generation)."""
+        na_cnt = int(sketch.na_cnt)
+        if not use_missing:
+            self.missing_type = MissingType.NONE
+        elif zero_as_missing:
+            self.missing_type = MissingType.ZERO
+        else:
+            self.missing_type = MissingType.NAN if na_cnt > 0 \
+                else MissingType.NONE
+        if sketch.categorical:
+            uniq, counts = sketch.categorical_counts()
+            self._fit_categorical_from_distinct(uniq, counts, max_bin)
+            return
+        self._fit_numerical_from_distinct(
+            sketch.values, sketch.counts, na_cnt, max_bin,
+            min_data_in_bin, min_split_data, pre_filter, forced_bounds)
 
     # -- transform ---------------------------------------------------------
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
@@ -413,3 +473,194 @@ class BinMapper:
         m.sparse_rate = float(st["sparse_rate"])
         m.bin0_frac = float(st.get("bin0_frac", 1.0))
         return m
+
+
+# ---------------------------------------------------------------------------
+# Mergeable quantile sketch (streaming / distributed FindBin substrate)
+# ---------------------------------------------------------------------------
+
+class QuantileSketch:
+    """Fixed-capacity mergeable summary of one feature's value
+    distribution — the streaming substrate FindBin fits from when the
+    raw rows never fit in host RAM (arXiv:1804.06755's per-shard
+    sketches merged into global bin bounds; arXiv:1611.01276's
+    ship-summaries-not-samples communication argument).
+
+    The sketch keeps sorted distinct (value, count) pairs and is EXACT
+    — a lossless ``np.unique`` of everything it has seen — until the
+    distinct count exceeds ``capacity``.  Past capacity it compacts
+    deterministically: representatives are picked at equal
+    cumulative-count targets (first, last and the 0.0 zero-band value
+    are always retained — the zero-aware FindBin carve-out needs the
+    exact zero count) and each dropped value's count folds into the
+    nearest retained representative on its left.  One compaction moves
+    no value's rank by more than the largest folded segment, ~2·n/
+    capacity rows; ``compactions`` counts the generations so callers
+    can report the bound (docs/Ingest.md "Equivalence").
+
+    ``update`` and ``merge`` are deterministic pure functions of the
+    (state, input) pair — every process merging the same shard
+    sketches in the same rank order derives byte-identical global
+    bounds, which is what lets ``parallel/dist_data.py`` allgather
+    sketches instead of raw samples.
+
+    Categorical mode (``categorical=True``) never compacts: category
+    ids are identity-significant, so the sketch is an exact value->
+    count map (real categorical cardinalities are far below any sane
+    capacity; a pathological one should raise, not silently merge
+    categories).
+    """
+
+    __slots__ = ("capacity", "categorical", "values", "counts", "n",
+                 "na_cnt", "compactions")
+
+    STATE_VERSION = 1
+
+    def __init__(self, capacity: int = 2048, categorical: bool = False):
+        self.capacity = max(16, int(capacity))
+        self.categorical = bool(categorical)
+        self.values = np.empty(0, np.float64)
+        self.counts = np.empty(0, np.int64)
+        self.n = 0                  # total non-NaN rows seen
+        self.na_cnt = 0
+        self.compactions = 0
+
+    # -- ingest -----------------------------------------------------------
+    def update(self, values: np.ndarray) -> "QuantileSketch":
+        """Fold a batch of raw values (NaNs counted separately)."""
+        values = np.asarray(values, dtype=np.float64)
+        nan_mask = np.isnan(values)
+        self.na_cnt += int(nan_mask.sum())
+        vals = values[~nan_mask]
+        if len(vals) == 0:
+            return self
+        if not self.categorical:
+            # the FindBin preprocessing, applied at ingest time so the
+            # lossless regime reproduces find_bin() byte-for-byte
+            vals = np.where(np.abs(vals) <= kZeroThreshold, 0.0, vals)
+        distinct, counts = np.unique(vals, return_counts=True)
+        self._fold(distinct, counts.astype(np.int64))
+        self.n += int(len(vals))
+        return self
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Deterministically absorb another sketch (same feature)."""
+        if other.categorical != self.categorical:
+            raise ValueError("cannot merge categorical and numerical "
+                             "sketches")
+        self._fold(other.values, other.counts)
+        self.n += int(other.n)
+        self.na_cnt += int(other.na_cnt)
+        self.compactions = max(self.compactions, int(other.compactions))
+        return self
+
+    def _fold(self, distinct: np.ndarray, counts: np.ndarray) -> None:
+        if len(self.values) == 0:
+            merged_v, merged_c = distinct, counts
+        else:
+            allv = np.concatenate([self.values, distinct])
+            allc = np.concatenate([self.counts, counts])
+            order = np.argsort(allv, kind="stable")
+            allv, allc = allv[order], allc[order]
+            # sum counts of duplicate values
+            uniq_mask = np.empty(len(allv), bool)
+            uniq_mask[0] = True
+            np.not_equal(allv[1:], allv[:-1], out=uniq_mask[1:])
+            idx = np.cumsum(uniq_mask) - 1
+            merged_v = allv[uniq_mask]
+            merged_c = np.zeros(len(merged_v), np.int64)
+            np.add.at(merged_c, idx, allc)
+        if not self.categorical and len(merged_v) > self.capacity:
+            merged_v, merged_c = self._compact(merged_v, merged_c)
+            self.compactions += 1
+        self.values, self.counts = merged_v, merged_c
+
+    def _compact(self, v: np.ndarray, c: np.ndarray):
+        """Deterministic capacity-bounded compaction (class docstring)."""
+        k = self.capacity
+        cum = np.cumsum(c, dtype=np.float64)
+        total = cum[-1]
+        # representative index per equal-weight target (one per slot)
+        targets = (np.arange(1, k + 1) / k) * total
+        keep = np.searchsorted(cum, targets, side="left")
+        keep = np.minimum(keep, len(v) - 1)
+        keep = np.union1d(keep, [0, len(v) - 1])
+        zpos = np.searchsorted(v, 0.0)
+        if zpos < len(v) and v[zpos] == 0.0:
+            keep = np.union1d(keep, [zpos])   # exact zero count survives
+        new_v = v[keep]
+        # fold each dropped value's count into the retained
+        # representative at or to its RIGHT (ranks never move left past
+        # a representative, so bin upper bounds stay upper bounds)
+        seg = np.searchsorted(keep, np.arange(len(v)), side="left")
+        new_c = np.zeros(len(keep), np.int64)
+        np.add.at(new_c, seg, c)
+        return new_v, new_c
+
+    # -- queries ----------------------------------------------------------
+    def zero_count(self) -> int:
+        z = np.searchsorted(self.values, 0.0)
+        if z < len(self.values) and self.values[z] == 0.0:
+            return int(self.counts[z])
+        return 0
+
+    def categorical_counts(self):
+        """(uniq int64 cats >= 0, counts) for the categorical tail."""
+        cats = self.values.astype(np.int64)
+        ok = cats >= 0
+        return cats[ok], self.counts[ok]
+
+    # -- serialization (the distributed allgather payload) ----------------
+    def to_state(self) -> dict:
+        return {"version": self.STATE_VERSION,
+                "capacity": int(self.capacity),
+                "categorical": bool(self.categorical),
+                "values": self.values, "counts": self.counts,
+                "n": int(self.n), "na_cnt": int(self.na_cnt),
+                "compactions": int(self.compactions)}
+
+    @classmethod
+    def from_state(cls, st: dict) -> "QuantileSketch":
+        if int(st.get("version", -1)) != cls.STATE_VERSION:
+            raise ValueError(
+                f"unsupported sketch state version {st.get('version')!r}")
+        s = cls(int(st["capacity"]), bool(st["categorical"]))
+        s.values = np.asarray(st["values"], np.float64)
+        s.counts = np.asarray(st["counts"], np.int64)
+        s.n = int(st["n"])
+        s.na_cnt = int(st["na_cnt"])
+        s.compactions = int(st["compactions"])
+        return s
+
+
+def sketch_features(x: np.ndarray, sketches: List[QuantileSketch]) -> None:
+    """Fold one raw row-chunk ``[n, F]`` into F per-feature sketches."""
+    if x.shape[1] != len(sketches):
+        raise ValueError(f"chunk has {x.shape[1]} features, "
+                         f"{len(sketches)} sketches")
+    for f, sk in enumerate(sketches):
+        sk.update(x[:, f])
+
+
+def fit_mappers_from_sketches(sketches: Sequence[QuantileSketch],
+                              config, cat_idx: Optional[set] = None
+                              ) -> List[BinMapper]:
+    """One BinMapper per feature sketch under ``config``'s binning
+    params — the FindBin step of the streaming ingest pass
+    (lightgbm_tpu/ingest.py) and of the distributed sketch allgather
+    (parallel/dist_data.py).  ``config`` is duck-typed (a
+    ``config.Config``): only the binning-relevant attributes are read."""
+    cat_idx = cat_idx or set()
+    mbf = config.max_bin_by_feature
+    mappers: List[BinMapper] = []
+    for f, sk in enumerate(sketches):
+        m = BinMapper()
+        mb = int(mbf[f]) if mbf else config.max_bin
+        m.find_bin_from_sketch(
+            sk, mb, config.min_data_in_bin,
+            min_split_data=config.min_data_in_leaf,
+            pre_filter=config.feature_pre_filter,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing)
+        mappers.append(m)
+    return mappers

@@ -8,6 +8,7 @@ with the reference's config-file syntax (``key = value``, ``#`` comments).
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from typing import Dict, List
@@ -70,6 +71,14 @@ def _load_dataset(cfg: Config, path: str, params: Dict,
                   reference=None) -> Dataset:
     if path.endswith(".npz") or path.endswith(".bin"):
         return Dataset.load_binary(path)
+    if cfg.ingest_enable or os.path.isdir(path):
+        # streaming out-of-core ingest (lightgbm_tpu/ingest.py):
+        # chunked + checkpointed + sketch-binned; a directory source
+        # (one chunk per file) implies it
+        from .ingest import ingest_dataset
+        return ingest_dataset(path, params, has_header=cfg.header,
+                              label_column=cfg.label_column,
+                              reference=reference)
     x, y = load_text(path, has_header=cfg.header,
                      label_column=cfg.label_column)
     return Dataset(x, label=y, params=params, reference=reference)

@@ -495,6 +495,38 @@ _PARAMS: Dict[str, tuple] = {
     # engine's byte-parity self-check probe (fall back to the host walk
     # on mismatch).  Disable only to shave load latency
     "serve_verify_artifacts": (bool, True, []),
+    # ---- out-of-core ingest (lightgbm_tpu/ingest.py) ----
+    # stream text data through bounded-memory chunks with a per-chunk
+    # spool + manifest (sha256, row span) so a killed loader resumes
+    # from the last complete chunk, and fit bin mappers from mergeable
+    # quantile sketches (binning.QuantileSketch) instead of a full
+    # in-memory sample.  Implied by passing a directory as ``data``
+    "ingest_enable": (bool, False, ["streaming_ingest"]),
+    # rows per chunk when splitting a single text file (directory
+    # sources use one chunk per file)
+    "ingest_chunk_rows": (int, 65536, []),
+    # spool/manifest directory; empty -> "<data>.ingest" next to the
+    # source
+    "ingest_dir": (str, "", ["ingest_spool_dir"]),
+    # resume from spooled chunks whose manifest verifies (byte-identical
+    # to the uninterrupted run); false re-ingests from scratch
+    "ingest_resume": (bool, True, []),
+    # persistently corrupt chunk (sha mismatch, parse failure, row-count
+    # drift) policy: "raise" fails the run, "skip" quarantines the chunk
+    # and keeps an accounting of the dropped rows
+    "ingest_bad_chunk": (str, "raise", []),
+    # transient read-error retries per chunk (attempts = retries + 1)
+    # and the base of their jittered exponential backoff
+    "ingest_retries": (int, 2, []),
+    "ingest_retry_backoff_s": (float, 0.1, []),
+    # per-chunk read+parse deadline: a reader wedged on a dead
+    # filesystem is abandoned (resilience.Watchdog raise mode) and the
+    # timeout classifies as retryable.  0 disables
+    "ingest_read_timeout_s": (float, 60.0, []),
+    # per-feature quantile-sketch capacity: distinct (value, count)
+    # pairs kept exactly; past this the sketch compacts with rank error
+    # ~2*rows/capacity per compaction generation (docs/Ingest.md)
+    "ingest_sketch_size": (int, 2048, []),
     # ---- IO / task ----
     "task": (str, "train", ["task_type"]),
     "data": (str, "", ["train", "train_data", "train_data_file", "data_filename"]),
@@ -823,6 +855,19 @@ class Config:
                 "elastic_heartbeat_interval_s")
         if self.elastic_retries < 0:
             raise ValueError("elastic_retries must be >= 0")
+        if self.ingest_bad_chunk not in ("raise", "skip"):
+            raise ValueError(
+                f"ingest_bad_chunk={self.ingest_bad_chunk!r} must be one "
+                "of: raise, skip")
+        if self.ingest_chunk_rows < 1:
+            raise ValueError("ingest_chunk_rows must be >= 1")
+        if self.ingest_retries < 0:
+            raise ValueError("ingest_retries must be >= 0")
+        for knob in ("ingest_retry_backoff_s", "ingest_read_timeout_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(f"{knob} must be >= 0 (0 disables)")
+        if self.ingest_sketch_size < 16:
+            raise ValueError("ingest_sketch_size must be >= 16")
         for knob in ("shadow_probe_tolerance",
                      "shadow_probe_metric_tolerance",
                      "shadow_probe_lineage_tolerance"):

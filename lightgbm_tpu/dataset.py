@@ -1116,6 +1116,19 @@ class Dataset:
         atomic_write(path, buf.getbuffer(), binary=True)
 
     @classmethod
+    def from_ingest(cls, source: str, params: Optional[Dict[str, Any]] = None,
+                    **kwargs) -> "Dataset":
+        """Streaming out-of-core construction from a chunked text source
+        (file or directory of chunks) via the survivable ingest pipeline
+        (lightgbm_tpu/ingest.py): checkpointed chunk spool + manifest,
+        retry/quarantine per chunk, bin mappers fitted from merged
+        quantile sketches.  Keyword args pass through to
+        ``ingest.ingest_dataset`` (``has_header``, ``label_column``,
+        ``categorical_idx``, ``spool_dir``, ``reference``)."""
+        from .ingest import ingest_dataset
+        return ingest_dataset(source, params, **kwargs)
+
+    @classmethod
     def load_binary(cls, path: str) -> "Dataset":
         if not os.path.exists(path) and os.path.exists(path + ".npz"):
             path = path + ".npz"

@@ -78,7 +78,7 @@ import numpy as np
 from ..obs.metrics import MetricsRegistry
 
 FAILURE_KINDS = ("collective_timeout", "host_loss", "claim_wedge",
-                 "bringup")
+                 "bringup", "ingest")
 
 # process-level elastic metrics: always-on and host-side only (a few
 # counter bumps per failure — nothing per-iteration), so they need no

@@ -74,6 +74,18 @@ Sites wired into the codebase:
                     default action ``hang``: the claim wedges and the
                     bring-up deadline must turn it into a classified
                     ``ElasticFailure`` instead of a silent hang
+``ingest_read``     chunk read+parse entry of the streaming ingest
+                    pipeline (``ingest.IngestRunner``) — exercises the
+                    per-chunk retry/backoff; ``exit`` between chunk
+                    commits is the kill -9 resume test
+``ingest_checksum`` chunk validation (``ingest.IngestRunner``) — a
+                    firing site simulates a CORRUPT chunk (sha
+                    mismatch class, not transient): quarantined per
+                    ``ingest_bad_chunk``, never retried
+``ingest_hang``     inside the chunk read (``ingest.IngestRunner``) —
+                    default action ``hang``: a reader wedged on a dead
+                    filesystem; the ``ingest_read_timeout_s`` watchdog
+                    must abandon + classify it
 ==================  ========================================================
 
 Also exercisable from ``tools/tpu_watch.py`` probes: export
@@ -93,10 +105,11 @@ KNOWN_SITES = ("device_claim", "collective", "snapshot_write",
                "serve_reload", "serve_self_check", "continual_append",
                "continual_boost", "continual_publish",
                "continual_promote", "shadow_probe", "collective_hang",
-               "host_loss", "claim_wedge")
+               "host_loss", "claim_wedge", "ingest_read",
+               "ingest_checksum", "ingest_hang")
 
 # sites whose realistic failure mode is a WEDGE, not an error
-_HANG_DEFAULT_SITES = ("collective_hang", "claim_wedge")
+_HANG_DEFAULT_SITES = ("collective_hang", "claim_wedge", "ingest_hang")
 
 # how long a firing ``hang`` action blocks: long enough that any sane
 # deadline fires first, short enough that an abandoned daemon thread

@@ -1,0 +1,52 @@
+"""Subprocess worker for the ingest kill -9 resume test
+(tests/test_ingest.py, the ``elastic_worker.py`` mold).
+
+One mode: ingest ``<outdir>/train.csv`` through the streaming pipeline
+(spool at ``<outdir>/<spoolname>``), train a small model, and write its
+text (parameters section stripped) to ``<outdir>/model_<tag>.txt``.
+
+The DRIVER arms the death: exporting
+``LGBM_TPU_FAULTS="ingest_read:<k>:exit"`` makes the k-th chunk read
+``os._exit(23)`` — a real mid-ingest death between chunk commits, after
+k-1 manifests landed.  A second invocation without the fault must
+resume from the manifests (never re-reading the committed chunks) and
+produce a model byte-identical to an uninterrupted run in a fresh
+spool.  Prints ``WORKER_DONE resumed=<n>`` on success.
+
+Usage: python ingest_worker.py <outdir> <spoolname> <tag>
+"""
+
+import os
+import sys
+
+ROUNDS = 8
+CHUNK_ROWS = 150
+
+
+def main():
+    outdir, spoolname, tag = sys.argv[1], sys.argv[2], sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from lightgbm_tpu.utils.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
+    import lightgbm_tpu as lgb
+
+    params = {"objective": "binary", "num_leaves": 8, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "ingest_chunk_rows": CHUNK_ROWS,
+              "ingest_retries": 0}
+    ds = lgb.ingest_dataset(os.path.join(outdir, "train.csv"), params,
+                            spool_dir=os.path.join(outdir, spoolname))
+    resumed = ds.ingest_report["resumed_chunks"]
+    bst = lgb.train(params, ds, num_boost_round=ROUNDS)
+    with open(os.path.join(outdir, f"model_{tag}.txt"), "w",
+              encoding="utf-8") as f:
+        f.write(bst.model_to_string().split("parameters:")[0])
+    print(f"WORKER_DONE resumed={resumed}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Tier-1 (short) run of the ingest chaos soak (tools/soak_ingest.py).
+
+One deterministic pass with all three injected failure kinds — transient
+read error, corrupt chunk, reader hang — plus the no-chaos control arm.
+The full-length soak is the standalone tool; this keeps its invariants
+(quarantine accounting, bounded wall clock, resume/heal parity) in every
+tier-1 run.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "tools"))
+
+from soak_ingest import run_soak_ingest  # noqa: E402
+
+
+def test_soak_ingest_chaos_short(tmp_path):
+    rep = run_soak_ingest(n_rows=1600, chunk_rows=200, rounds=3,
+                          chaos=True, hang_s=6.0, budget_s=90.0,
+                          workdir=str(tmp_path))
+    assert rep["violations"] == []
+    assert rep["report"]["dropped_rows"] == 200
+    assert len(rep["report"]["quarantined"]) == 1
+
+
+@pytest.mark.slow
+def test_soak_ingest_control(tmp_path):
+    rep = run_soak_ingest(n_rows=1000, chunk_rows=250, rounds=3,
+                          chaos=False, workdir=str(tmp_path))
+    assert rep["violations"] == []

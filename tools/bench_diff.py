@@ -56,7 +56,8 @@ BUDGET = os.path.join(REPO, "tools", "perf_budget.txt")
 _LOWER_BETTER = re.compile(
     r"(_ms|compile_s|_seconds|_lag_s|_gen_s|_hbm_bytes_per_iter"
     r"|_ms_per_pass|_ms_per_leaf(_k\d+|_wide)?"
-    r"|_sync(s|_count)_per_iter)$")
+    r"|_sync(s|_count)_per_iter"
+    r"|_peak_rss_mb|_wire_bytes)$")
 # extras worth gating by default: primary value, throughput points,
 # serve latency/throughput (host-accumulation AND fused device paths),
 # mfu, the continual pipeline's freshness numbers, and the histogram
@@ -73,7 +74,12 @@ _GATEABLE = re.compile(
     # per-k sweep keys
     r"|^superepoch_(iters_per_s|sync_count_per_iter"
     r"|k\d+_(valid|novalid)_(iters_per_s|syncs_per_iter))$"
-    r"|^continual_(freshness_lag_s|gen_s)$)")
+    r"|^continual_(freshness_lag_s|gen_s)$"
+    # out-of-core ingest (ISSUE 17, lightgbm_tpu/ingest.py): streaming
+    # throughput, the bounded-memory subprocess RSS, and the
+    # sketch-allgather wire bytes
+    r"|^ingest_(rows_per_s|peak_rss_mb)$"
+    r"|^binning_wire_bytes$)")
 _DEFAULT_TOL = {"higher": 0.20, "lower": 0.30}
 
 
