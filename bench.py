@@ -573,6 +573,24 @@ def child_extras() -> None:
     except Exception as e:
         _record_point("superepoch", error=f"{type(e).__name__}: {e}"[:200])
 
+    # fleet sweep (ISSUE 19, tools/bench_fleet.run_bench): warm
+    # aggregate iters/s of ONE vmapped N-member fleet_train vs N warm
+    # sequential solo runs, N in {1, 4, 8, 16}.  The shape is the
+    # fleet's home regime — a small-data hyperparameter sweep, where
+    # per-epoch dispatch dominates and batching members into one
+    # program wins.  Headline keys fold as fleet_agg_iters_per_s (the
+    # N=8 vmapped aggregate, pinned in tools/perf_budget.txt) and
+    # fleet_speedup_x8 (the >=2x acceptance ratio vs 8 solos)
+    try:
+        sys.path.insert(0, os.path.join(_DIR, "tools"))
+        import bench_fleet
+        fp = bench_fleet.run_bench(
+            n_rows=500, rounds=32,
+            sizes=(1, 4, 8) if cpu else (1, 4, 8, 16))
+        _record_point("fleet", cpu=cpu, **fp)
+    except Exception as e:
+        _record_point("fleet", error=f"{type(e).__name__}: {e}"[:200])
+
     # out-of-core ingest microbench (ISSUE 17, lightgbm_tpu/ingest.py):
     # streaming rows/s through the chunked reader + quantile sketcher,
     # peak RSS of a SUBPROCESS ingesting a many-chunk file (the

@@ -18,6 +18,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .dataset import Dataset, Sequence
 from .engine import CVBooster, cv, train
+from .fleet import FleetResult, fleet_train
 from .ingest import IngestRunner, ingest_dataset
 from .pipeline import ContinualTrainer, GateFailure
 from .plotting import (create_tree_digraph, plot_importance, plot_metric,
@@ -29,7 +30,8 @@ __all__ = [
     "BinMapper", "BinType", "MissingType", "Booster", "Config",
     "ContinualTrainer", "CVBooster",
     "Dataset", "EarlyStopException", "GateFailure", "IngestRunner",
-    "LightGBMError", "Sequence", "cv", "ingest_dataset",
+    "FleetResult", "LightGBMError", "Sequence", "cv", "fleet_train",
+    "ingest_dataset",
     "early_stopping", "log_evaluation", "log_telemetry",
     "record_evaluation", "reset_parameter", "train",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",

@@ -24,7 +24,7 @@ from . import callback as cb
 
 
 _BARE_TASKS = ("train", "predict", "refit", "serve", "continual",
-               "save_binary", "convert_model")
+               "fleet", "save_binary", "convert_model")
 
 
 def _load_params(argv: List[str]) -> Dict[str, str]:
@@ -59,6 +59,8 @@ def run(argv: List[str]) -> int:
         return _task_serve(cfg, params)
     if task == "continual":
         return _task_continual(cfg, params)
+    if task == "fleet":
+        return _task_fleet(cfg, params)
     if task == "save_binary":
         return _task_save_binary(cfg, params)
     if task == "convert_model":
@@ -107,6 +109,37 @@ def _task_train(cfg: Config, params: Dict) -> int:
           f"model saved to {cfg.output_model}")
     if cfg.save_binary:
         train_set.save_binary(cfg.data + ".bin.npz")
+    return 0
+
+
+def _task_fleet(cfg: Config, params: Dict) -> int:
+    """``task=fleet`` / ``python -m lightgbm_tpu fleet``: train N
+    boosters over one dataset inside one vmapped program per epoch
+    (docs/Fleet.md).  The roster comes from ``fleet_sweep`` (a
+    ``param=v1|v2;...`` grid over member-axis params) or
+    ``fleet_members`` (N seed replicas); each member's model is saved
+    to ``<output_model>.member<j>``."""
+    from .fleet import fleet_train
+    t0 = time.time()
+    train_set = _load_dataset(cfg, cfg.data, params)
+    valid_sets, valid_names = [], []
+    for i, vpath in enumerate(cfg.valid or []):
+        valid_sets.append(_load_dataset(cfg, str(vpath), params,
+                                        reference=train_set))
+        valid_names.append(f"valid_{i}")
+    result = fleet_train(params, train_set,
+                         num_boost_round=cfg.num_iterations,
+                         valid_sets=valid_sets or None,
+                         valid_names=valid_names or None)
+    for j, booster in enumerate(result.boosters):
+        out = Config(result.member_params[j]).output_model
+        booster.save_model(out)
+        print(f"member {j}: {len(booster.trees)} trees"
+              f"{' (early-stopped)' if result.stopped[j] else ''}"
+              f" -> {out}")
+    print(f"Finished fleet training ({len(result)} members, "
+          f"{result.epochs} vmapped epochs) in "
+          f"{time.time() - t0:.2f} seconds")
     return 0
 
 

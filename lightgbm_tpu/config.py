@@ -210,6 +210,20 @@ _PARAMS: Dict[str, tuple] = {
     # config qualifies), >0 = explicit epoch size, -1 = disable (always
     # per-iteration eval)
     "superepoch": (int, 0, []),
+    # ---- fleet training (lightgbm_tpu/fleet/, docs/Fleet.md) ----
+    # number of fleet members when no explicit sweep is given: N seed
+    # replicas of the base params — member j trains with seed+j,
+    # bagging_seed+j, feature_fraction_seed+j, byte-identical to a solo
+    # run with those seeds.  0 disables (fleet_train needs members from
+    # one of fleet_members / fleet_sweep / the members= argument)
+    "fleet_members": (int, 0, []),
+    # sweep spec: "param=v1|v2;param2=v3|v4" — the cartesian grid of
+    # the listed member-axis params (learning_rate, seed, bagging_seed,
+    # feature_fraction_seed, num_leaves) becomes the fleet roster.  All
+    # members grow inside ONE vmapped super-epoch program; num_leaves
+    # variation requires padded_leaves bucketing (the same one-trace
+    # rule the solo path pins)
+    "fleet_sweep": (str, "", []),
     # traced on-device metric evaluation (metrics.traced_metric_fn):
     # "auto" uses traced (f32) eval wherever the super-epoch engages and
     # host (f64) eval elsewhere; "true" forces traced eval in the
@@ -490,6 +504,17 @@ _PARAMS: Dict[str, tuple] = {
     # /drain / Server.drain): new work is refused, queued work finishes
     # within the budget, leftovers fail with BatcherClosed
     "serve_drain_s": (float, 5.0, []),
+    # per-request segment routing (fleet serving, docs/Fleet.md):
+    # requests carrying segment=<key> are routed to the model version
+    # the SegmentRouter maps that key to; unknown keys fall back to the
+    # default segment's version (or the registry's current model when
+    # the default is unassigned)
+    "serve_default_segment": (str, "default", []),
+    # cardinality bound for per-version / per-segment serve metric
+    # labels: beyond this many distinct label values, further ones
+    # aggregate into one "__other__" bucket so a 500-segment fleet
+    # cannot bloat the /metrics exposition.  0 = unlimited
+    "serve_metrics_max_versions": (int, 32, []),
     # verify artifacts before activation: SHA-256 of model files
     # against the snapshot manifest's recorded checksum, plus the
     # engine's byte-parity self-check probe (fall back to the host walk
@@ -825,6 +850,12 @@ class Config:
         if self.serve_max_resident < 0:
             raise ValueError("serve_max_resident must be >= 0 "
                              "(0 = unlimited resident versions)")
+        if self.serve_metrics_max_versions < 0:
+            raise ValueError("serve_metrics_max_versions must be >= 0 "
+                             "(0 = unlimited metric label values)")
+        if self.fleet_members < 0:
+            raise ValueError("fleet_members must be >= 0 "
+                             "(0 = no implicit seed-replica roster)")
         if self.serve_breaker_failures < 0:
             raise ValueError("serve_breaker_failures must be >= 0 "
                              "(0 disables the breaker)")

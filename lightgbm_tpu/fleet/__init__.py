@@ -1,0 +1,21 @@
+"""Fleet subsystem: vmapped multi-forest training and segment-routed
+fleet serving (docs/Fleet.md).
+
+Training (``fleet/trainer.py``): N same-shape boosters — segments, seed
+replicas, or a hyperparameter grid — grow inside ONE jitted program by
+``jax.vmap``-ping the super-epoch scan (models/gbdt.py PR 16) over a
+member axis.  Per-member RNG streams ride as traced arguments, so every
+member trains BYTE-IDENTICAL to a solo ``train()`` run with that
+member's params; one host fetch per epoch serves all members.
+
+Serving (``fleet/router.py``): per-request ``segment`` keys map to
+model versions co-resident in the serve registry; same-family segments
+share every serve trace through the existing pow2 SoA padding, so a
+hundred-segment fleet adds ZERO new compiled programs.
+"""
+
+from .router import SegmentRouter
+from .trainer import FleetResult, expand_members, fleet_train, parse_sweep
+
+__all__ = ["FleetResult", "SegmentRouter", "expand_members",
+           "fleet_train", "parse_sweep"]

@@ -1,0 +1,644 @@
+"""Vmapped multi-forest fleet training (docs/Fleet.md).
+
+``fleet_train`` grows N boosters — seed replicas, a hyperparameter
+grid, or per-segment models over one dataset — inside ONE jitted
+program per epoch: the super-epoch scan (models/gbdt.py PR 16) is
+``jax.vmap``-ped over a leading member axis, so the histogram
+contraction runs batched ``[N, L, ...]`` shapes through the same MXU
+kernels, and ONE host fetch per epoch carries every member's trees,
+eval block and early-stop flags.
+
+The contract that makes this more than a throughput trick:
+
+- **Byte identity.**  Every member's trained model is byte-identical
+  to a solo ``train()`` with that member's params.  Per-member RNG
+  streams (bagging, GOSS, stochastic rounding) ride as traced
+  arguments into the SAME arithmetic the solo program runs; feature-
+  fraction masks are drawn per member from each member's own host RNG;
+  eval values replay through member 0's shared teval program (one
+  trace, deterministic math).
+- **Masked, not branched, early stop.**  A member whose replay stops
+  keeps riding its lane with the stop flag latched: blocked lanes make
+  ZERO state changes in-scan, and the host simply stops ingesting
+  their rows — no retrace when fleet membership shrinks.
+- **Ragged progress.**  Per-member iteration indices are operands, so
+  members at different absolute iterations (a healed vote/replay
+  disagreement) share epochs until fewer than two members remain,
+  then finish through the ordinary solo path.
+- **Survivability.**  Per-member snapshots (``<output_model>.member<j>``)
+  land at the same epoch boundaries the solo path uses; kill+resume
+  restores all N members byte-identically.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import callback as callback_mod
+from ..booster import Booster
+from ..callback import CallbackEnv, EarlyStopException
+from ..config import _ALIASES, _PARAMS, Config, _coerce, canonical_params
+from ..dataset import Dataset
+from ..engine import _superepoch_plan
+from ..utils.log import Log
+
+# params allowed to differ between fleet members: everything else must
+# be uniform, because the fleet program bakes it once (member 0's) and
+# every lane runs the same compiled scan.  num_leaves may differ only
+# under padded_leaves bucketing with equal split-batch width — exactly
+# the solo _SE_CACHE sharing rule.
+MEMBER_AXIS_PARAMS = frozenset({
+    "learning_rate", "seed", "bagging_seed", "feature_fraction_seed",
+    "num_leaves", "output_model"})
+
+
+def parse_sweep(spec: str) -> List[Dict[str, Any]]:
+    """``"learning_rate=0.05|0.1;num_leaves=31|63"`` -> the cartesian
+    grid as member override dicts (4 members here), values coerced to
+    the parameter's declared type.  Only member-axis params may be
+    swept; aliases resolve (``eta=...`` sweeps learning_rate)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return []
+    axes: List[tuple] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"fleet_sweep: malformed entry {part!r} "
+                             "(want param=v1|v2|...)")
+        name, vals = part.split("=", 1)
+        name = _ALIASES.get(name.strip(), name.strip())
+        if name not in _PARAMS:
+            raise ValueError(f"fleet_sweep: unknown parameter {name!r}")
+        if name not in MEMBER_AXIS_PARAMS:
+            raise ValueError(
+                f"fleet_sweep: {name!r} is not a member-axis parameter "
+                f"(sweepable: {sorted(MEMBER_AXIS_PARAMS - {'output_model'})})")
+        typ = _PARAMS[name][0]
+        axes.append((name, [_coerce(name, typ, v.strip())
+                            for v in vals.split("|") if v.strip()]))
+    if not axes:
+        return []
+    return [dict(zip([n for n, _ in axes], combo))
+            for combo in itertools.product(*[vs for _, vs in axes])]
+
+
+def expand_members(params: Dict[str, Any],
+                   members: Optional[Sequence[Dict[str, Any]]] = None,
+                   ) -> List[Dict[str, Any]]:
+    """Resolve the fleet roster into full per-member param dicts.
+
+    Precedence: an explicit ``members=`` override list > the
+    ``fleet_sweep`` grid > ``fleet_members`` seed replicas (member j
+    trains with ``seed+j`` / ``bagging_seed+j`` /
+    ``feature_fraction_seed+j``)."""
+    cfg = Config(params)
+    if members is not None:
+        over = [dict(m) for m in members]
+    elif cfg.fleet_sweep:
+        over = parse_sweep(cfg.fleet_sweep)
+    elif cfg.fleet_members > 0:
+        over = [{"seed": cfg.seed + j,
+                 "bagging_seed": cfg.bagging_seed + j,
+                 "feature_fraction_seed": cfg.feature_fraction_seed + j}
+                for j in range(cfg.fleet_members)]
+    else:
+        over = []
+    out = []
+    for j, ov in enumerate(over):
+        mp = dict(params)
+        explicit_out = False
+        for k, v in ov.items():
+            name = _ALIASES.get(k, k)
+            if name not in MEMBER_AXIS_PARAMS:
+                raise ValueError(
+                    f"fleet member {j}: {name!r} is not a member-axis "
+                    "parameter — fleet members must share every "
+                    "structural param (the one-program contract)")
+            mp[name] = v
+            explicit_out = explicit_out or name == "output_model"
+        if not explicit_out:
+            # per-member model/snapshot paths: members must never share
+            # an output path or their snapshots overwrite each other
+            mp["output_model"] = f"{cfg.output_model}.member{j}"
+        out.append(mp)
+    return out
+
+
+class FleetResult:
+    """What ``fleet_train`` returns: the trained boosters plus the
+    per-member params and stop bookkeeping, in roster order."""
+
+    def __init__(self, boosters, member_params, stopped, epochs):
+        self.boosters: List[Booster] = boosters
+        self.member_params: List[Dict[str, Any]] = member_params
+        self.stopped: List[bool] = stopped       # ES/stump per member
+        self.epochs: int = epochs                # fleet epochs dispatched
+
+    def __len__(self) -> int:
+        return len(self.boosters)
+
+    def __getitem__(self, j: int) -> Booster:
+        return self.boosters[j]
+
+
+def _check_uniform(member_params: List[Dict[str, Any]]) -> None:
+    """Every canonical param outside MEMBER_AXIS_PARAMS must be equal
+    across the roster."""
+    base = None
+    for j, mp in enumerate(member_params):
+        cp = {k: repr(v) for k, v in sorted(canonical_params(mp).items())
+              if k not in MEMBER_AXIS_PARAMS}
+        if base is None:
+            base = cp
+        elif cp != base:
+            diff = sorted(set(cp.items()) ^ set(base.items()))
+            raise ValueError(
+                f"fleet member {j} differs from member 0 outside the "
+                f"member axis: {sorted({k for k, _ in diff})} — fleet "
+                "members must share every structural param")
+
+
+def _check_models(boosters: List[Booster]) -> None:
+    """Structural uniformity the vmapped program requires beyond the
+    param surface: one process, dense binned data, equal shape-bucket
+    residue — mirrors the solo ``_superepoch_key`` sharing rule."""
+    import jax
+    sig0 = None
+    for j, b in enumerate(boosters):
+        m = b._model
+        if m is None or not hasattr(m, "train_superepoch"):
+            raise ValueError(f"fleet member {j}: boosting type "
+                             "does not support the super-epoch trainer")
+        if m._pc > 1:
+            raise ValueError("fleet_train is single-process only "
+                             "(tree_learner parallelism composes with "
+                             "solo training, not the member axis)")
+        if m._cegb_state is not None:
+            raise ValueError("fleet_train does not support cegb_* "
+                             "(per-member host feature-cost state)")
+        if not isinstance(m.binned_dev, jax.Array):
+            raise ValueError("fleet_train needs dense device binned "
+                             "data (sparse_data is solo-only)")
+        cfg = m.config
+        k_eff = max(1, min(m._split_batch, cfg.num_leaves - 1)) \
+            if cfg.num_leaves > 1 else 1
+        sig = (m._leaf_pad, m._split_batch, m._block_rows,
+               m._hist_overlap, m._learner_kind, m._se_steps(),
+               m.max_bin, k_eff, len(m.valid_sets),
+               type(m.objective).__name__)
+        if sig0 is None:
+            sig0 = sig
+        elif sig != sig0:
+            raise ValueError(
+                f"fleet member {j} compiles a different program shape "
+                f"than member 0 ({sig} vs {sig0}): num_leaves may only "
+                "differ under padded_leaves bucketing with equal "
+                "split_batch width (the solo trace-sharing rule)")
+
+
+def fleet_train(params: Dict[str, Any], train_set: Dataset,
+                num_boost_round: int = 100,
+                valid_sets: Optional[List[Dataset]] = None,
+                valid_names: Optional[List[str]] = None,
+                callbacks: Optional[Callable[[int], list]] = None,
+                members: Optional[Sequence[Dict[str, Any]]] = None,
+                ) -> FleetResult:
+    """Train a fleet of N boosters over ONE shared dataset inside one
+    vmapped super-epoch program per epoch (module docstring).
+
+    ``callbacks`` is a FACTORY ``f(member_index) -> [callback, ...]``
+    (not a list): callbacks carry per-run state, so members must not
+    share instances.  Early stopping from ``early_stopping_round`` is
+    instantiated per member automatically.
+
+    Every member's config must qualify for the super-epoch plan
+    (engine._superepoch_plan); anything else raises rather than
+    silently training a different program than solo would."""
+    import jax.numpy as jnp
+
+    params = dict(params or {})
+    resume_req = False
+    for k in list(params):
+        if _ALIASES.get(k, k) == "resume":
+            resume_req = bool(_coerce("resume", bool, params.pop(k)))
+    base_cfg = Config(params)
+    from ..utils.compile_cache import maybe_enable_from_config
+    maybe_enable_from_config(base_cfg)
+    if "num_iterations" in canonical_params(params):
+        num_boost_round = base_cfg.num_iterations
+
+    member_params = expand_members(params, members)
+    N = len(member_params)
+    if N < 2:
+        raise ValueError(
+            "fleet_train needs >= 2 members — set fleet_members, "
+            "fleet_sweep, or pass members=[...] overrides")
+    for mp in member_params:
+        mp["num_iterations"] = num_boost_round
+    _check_uniform(member_params)
+    if callbacks is not None and not callable(callbacks):
+        raise ValueError("fleet_train callbacks must be a factory "
+                         "f(member_index) -> [callback, ...] — a shared "
+                         "list would share callback state across members")
+    if valid_sets is not None and not isinstance(valid_sets,
+                                                 (list, tuple)):
+        valid_sets = [valid_sets]
+    if valid_sets and any(vs is train_set for vs in valid_sets):
+        raise ValueError("fleet_train does not support the training "
+                         "set in valid_sets (training-metric replay is "
+                         "a solo-path feature)")
+
+    # per-member resume bookkeeping (snapshots are per member)
+    sigs = [None] * N
+    member_cfgs = [Config(mp) for mp in member_params]
+    if any(c.snapshot_freq > 0 for c in member_cfgs) or resume_req:
+        from ..snapshot import params_signature
+        sigs = [params_signature(mp) for mp in member_params]
+    resume_start = 0
+    resume_scores: List[Optional[np.ndarray]] = [None] * N
+    prev_boosters: List[Optional[Booster]] = [None] * N
+    if resume_req:
+        from ..snapshot import find_latest_snapshot
+        found = [find_latest_snapshot(member_cfgs[j].output_model,
+                                      sigs[j], train_set)
+                 for j in range(N)]
+        if all(f is not None for f in found) \
+                and len({f[0] for f in found}) == 1:
+            resume_start = found[0][0]
+            for j, (it, path, score) in enumerate(found):
+                prev_boosters[j] = Booster(model_file=path)
+                resume_scores[j] = score
+            Log.info(f"fleet auto-resume: all {N} members continuing "
+                     f"from iteration {resume_start}")
+        elif any(f is not None for f in found):
+            Log.warning("fleet resume: members disagree on the newest "
+                        "common snapshot iteration; training the fleet "
+                        "from scratch")
+        else:
+            Log.info("fleet resume=true but no valid snapshots found; "
+                     "training from scratch")
+
+    # construct the members over the SHARED dataset.  Resume feeds the
+    # saved f32 score straight into the model (the shared Dataset's
+    # init_score cannot carry per-member state): zeros + score is the
+    # same bits the solo init_model path computes.
+    boosters: List[Booster] = []
+    for j, mp in enumerate(member_params):
+        b = Booster(params=mp, train_set=train_set)
+        m = b._model
+        if resume_start and resume_scores[j] is not None:
+            init = np.zeros((m.num_data, m.num_class), np.float32)
+            init += np.asarray(resume_scores[j],
+                               np.float32).reshape(m.num_data, -1)
+            m.score = jnp.asarray(init)
+            m._init_applied = True
+            m.set_resume_state(resume_start)
+        if valid_sets:
+            names = valid_names or [f"valid_{i}"
+                                    for i in range(len(valid_sets))]
+            for vs, name in zip(valid_sets, names):
+                b.add_valid(vs, name)
+        boosters.append(b)
+    _check_models(boosters)
+
+    # per-member callbacks + the shared super-epoch plan
+    plans = []
+    cbs_after_all: List[list] = []
+    for j, b in enumerate(boosters):
+        cfg_j = member_cfgs[j]
+        cbs = list(callbacks(j)) if callbacks is not None else []
+        if cfg_j.early_stopping_round and cfg_j.early_stopping_round > 0:
+            cbs.append(callback_mod.early_stopping(
+                cfg_j.early_stopping_round, cfg_j.first_metric_only,
+                cfg_j.verbosity > 0))
+        cbs_before = [c for c in cbs
+                      if getattr(c, "before_iteration", False)]
+        cbs_after = [c for c in cbs
+                     if not getattr(c, "before_iteration", False)]
+        cbs_before.sort(key=lambda c: getattr(c, "order", 0))
+        cbs_after.sort(key=lambda c: getattr(c, "order", 0))
+        plan = _superepoch_plan(cfg_j, b, None, None, cbs_before,
+                                cbs_after, None)
+        if plan is None:
+            raise ValueError(
+                f"fleet member {j}: config does not qualify for the "
+                "super-epoch trainer (custom fobj/feval, non-replayable "
+                "callbacks, sparse valid sets, or untraced metrics) — "
+                "fleet_train has no per-iteration fallback")
+        plans.append(plan)
+        cbs_after_all.append(cbs_after)
+    base_k, eval_spec, es_spec = plans[0]
+    for j, p in enumerate(plans[1:], 1):
+        if p != plans[0]:
+            raise ValueError(f"fleet member {j}: super-epoch plan "
+                             f"differs from member 0 ({p} vs "
+                             f"{plans[0]}) — members must share one "
+                             "epoch shape")
+    E = len(eval_spec)
+
+    # ONE fleet program for the whole run (the retrace pin: N members,
+    # mixed num_leaves buckets, one `fleet_superepoch` trace)
+    m0 = boosters[0]._model
+    obj_parts = m0._obj_array_attrs()
+    fleet_fn = m0.fleet_superepoch_fn(eval_spec, es_spec, obj_parts, N)
+    obj_arrs = obj_parts[1] if obj_parts is not None else ()
+    teval0 = m0._teval_fn(eval_spec) if E else None
+    mrng = (jnp.asarray([c.learning_rate for c in member_cfgs],
+                        jnp.float32),
+            jnp.asarray([c.bagging_seed for c in member_cfgs],
+                        jnp.int32),
+            jnp.asarray([c.seed for c in member_cfgs], jnp.int32))
+    ml = jnp.asarray([c.num_leaves for c in member_cfgs], jnp.int32)
+
+    obs0 = getattr(m0, "_obs", None)
+    if obs0 is not None:
+        obs0.metrics.gauge("fleet.members").set(N)
+
+    from ..obs.flops import member_axis
+    from ..snapshot import write_snapshot
+
+    rounds = [resume_start] * N       # absolute boosting rounds done
+    exited = [False] * N              # lane no longer ingests
+    stopped_f = [False] * N           # ES raised / stump (final stop)
+    epochs = 0
+    while True:
+        active = [j for j in range(N) if not exited[j]]
+        if len(active) < 2:
+            break
+        k_eff = min(base_k,
+                    min(num_boost_round - rounds[j] for j in active))
+        for j in active:
+            cfg_j = member_cfgs[j]
+            if cfg_j.snapshot_freq > 0:
+                k_eff = min(k_eff, cfg_j.snapshot_freq
+                            - rounds[j] % cfg_j.snapshot_freq)
+        if k_eff < 2:
+            break
+
+        # per-member prologue + operands — member order is the RNG
+        # contract (_se_operands draws each member's feature masks)
+        init0s = [0.0] * N
+        spans = [None] * N
+        start_iters = [0] * N
+        ops = []
+        for j, b in enumerate(boosters):
+            m = b._model
+            start_iters[j] = m.iter_
+            init0s[j], spans[j] = m._se_begin(k_eff, E)
+            ops.append(m._se_operands(k_eff, rounds[j], E))
+
+        score0 = jnp.stack([b._model.score for b in boosters])
+        fmasks = jnp.stack([o[0] for o in ops])
+        iters = jnp.stack([o[1] for o in ops])
+        eiters = jnp.stack([o[2] for o in ops])
+        cuse0 = ops[0][3]
+        es_state = (
+            jnp.stack([o[4][0] for o in ops]),
+            jnp.stack([o[4][1] for o in ops]),
+            jnp.stack([o[4][2] for o in ops]),
+            jnp.stack([jnp.bool_(True) if exited[j] else ops[j][4][3]
+                       for j in range(N)]))
+        vscores = tuple(jnp.stack([ops[j][5][vi] for j in range(N)])
+                        for vi in range(len(m0.valid_sets)))
+        valid_ops = ops[0][6]
+
+        with member_axis(N):
+            (score_out, new_vsc, es_out, stacked, bad_flags, stops_dev,
+             vstack) = fleet_fn(score0, vscores, es_state, fmasks,
+                                iters, eiters, cuse0, ml, m0.binned_dev,
+                                m0._nb_grow, m0._na_grow, m0.na_bin_dev,
+                                obj_arrs, valid_ops, mrng)
+        epochs += 1
+        if E:
+            ev_all = jnp.stack([
+                boosters[j]._model._se_eval_block(
+                    tuple(v[j] for v in vstack), eval_spec, k_eff,
+                    teval=teval0)
+                for j in range(N)])
+        else:
+            ev_all = jnp.zeros((N, k_eff, 0), jnp.float32)
+        # the ONE host sync of the epoch: every member's trees, guard
+        # flags, eval block and stop rows in a single fetch
+        host, bad_host, ev_host, stops_np = m0._eget(
+            (stacked, bad_flags, ev_all, stops_dev), "fleet_fetch")
+
+        for j, b in enumerate(boosters):
+            m = b._model
+            m.score = score_out[j]
+            m._se_absorb(tuple(v[j] for v in new_vsc),
+                         tuple(t[j] for t in es_out))
+            obs_j = getattr(m, "_obs", None)
+            if obs_j is not None and spans[j] is not None:
+                spans[j].end()
+                if obs_j.profiler is not None:
+                    obs_j.profiler.on_iter_end(start_iters[j]
+                                               + k_eff - 1)
+            if exited[j]:
+                continue
+            res = m._se_ingest(tuple(f[j] for f in host),
+                               tuple(f[j] for f in stacked),
+                               bad_host[j], stops_np[j],
+                               np.asarray(ev_host)[j], k_eff,
+                               start_iters[j], init0s[j], E)
+            b._sync_trees()
+            done = res["done"]
+            round0 = rounds[j]
+            cfg_j = member_cfgs[j]
+            if cfg_j.snapshot_freq > 0 and done == k_eff \
+                    and (round0 + done) % cfg_j.snapshot_freq == 0:
+                try:
+                    write_snapshot(b, prev_boosters[j], cfg_j,
+                                   round0 + done, sigs[j], train_set)
+                except Exception as e:
+                    Log.warning(f"fleet member {j} snapshot at "
+                                f"iteration {round0 + done} failed "
+                                f"({e}); training continues")
+            es_raised = False
+            for r in range(done):
+                ev_row = [(nm, mn, float(res["evals"][r][e]), hib)
+                          for e, (_vi, nm, mn, hib)
+                          in enumerate(eval_spec)]
+                env = CallbackEnv(model=b, params=member_params[j],
+                                  iteration=round0 + r,
+                                  begin_iteration=0,
+                                  end_iteration=num_boost_round,
+                                  evaluation_result_list=ev_row)
+                try:
+                    for cb in cbs_after_all[j]:
+                        cb(env)
+                except EarlyStopException as e:
+                    _apply_early_stop(b, prev_boosters[j], e,
+                                      resume_start)
+                    es_raised = True
+                    extra = done - (r + 1)
+                    if extra > 0:
+                        Log.warning(
+                            f"fleet member {j}: vote overshot the host "
+                            f"early stop by {extra} iteration(s); "
+                            "dropping surplus trees")
+                        m.drop_iterations(extra)
+                        b._sync_trees()
+                    break
+            rounds[j] = resume_start + b.current_iteration
+            if es_raised or res["stump"]:
+                exited[j] = True
+                stopped_f[j] = True
+                if obs0 is not None:
+                    obs0.metrics.counter("fleet.stopped").inc()
+            elif res["stop_row"] is not None:
+                Log.warning(f"fleet member {j}: early-stop vote "
+                            "tripped but the host callbacks did not; "
+                            "resuming")
+                m.clear_es_stop()
+            if rounds[j] >= num_boost_round:
+                exited[j] = True
+        if obs0 is not None:
+            obs0.metrics.counter("fleet.epochs").inc()
+            obs0.metrics.gauge("fleet.active").set(
+                sum(1 for j in range(N) if not exited[j]))
+            _note_member_evals(obs0, boosters, member_cfgs, eval_spec)
+
+    # stragglers (vote/replay disagreement, odd remainders, or a fleet
+    # reduced below two members) finish through the ordinary solo path
+    # — byte-identical by construction
+    for j in range(N):
+        if not exited[j]:
+            _solo_finish(boosters[j], member_cfgs[j], member_params[j],
+                         prev_boosters[j], sigs[j], train_set,
+                         cbs_after_all[j], plans[j], rounds, j,
+                         num_boost_round, resume_start)
+
+    for j, b in enumerate(boosters):
+        if prev_boosters[j] is not None:
+            b.trees = prev_boosters[j].trees + b.trees
+            b.tree_weights = (prev_boosters[j].tree_weights
+                              + b.tree_weights)
+    return FleetResult(boosters, member_params, stopped_f, epochs)
+
+
+def _apply_early_stop(b: Booster, prev: Optional[Booster],
+                      e: EarlyStopException, resume_start: int) -> None:
+    """engine.train's EarlyStopException bookkeeping, verbatim."""
+    best_iter_offset = 0
+    if prev is not None:
+        k = max(1, b._num_tree_per_iteration)
+        best_iter_offset = len(prev.trees) // k - resume_start
+    b.best_iteration = best_iter_offset + e.best_iteration + 1
+    for (name, metric, value, _) in e.best_score:
+        b.best_score.setdefault(name, {})[metric] = value
+
+
+def _note_member_evals(obs0, boosters, member_cfgs, eval_spec) -> None:
+    """Per-member eval gauges, cardinality-bounded: members beyond
+    ``serve_metrics_max_versions`` aggregate under one ``__other__``
+    label so a 500-member fleet cannot bloat the exposition."""
+    if not eval_spec:
+        return
+    cap = member_cfgs[0].serve_metrics_max_versions
+    for j, b in enumerate(boosters):
+        m = b._model
+        es = getattr(m, "_es_dev", None)
+        if es is None:
+            continue
+        label = str(j) if (cap <= 0 or j < cap) else "__other__"
+        g = obs0.metrics.gauge("fleet.member_best", member=label)
+        try:
+            g.set(float(np.asarray(es[0])[0]))
+        except (TypeError, ValueError, IndexError):
+            pass
+
+
+def _solo_finish(b: Booster, cfg, mparams, prev, sig, train_set,
+                 cbs_after, plan, rounds, j, num_boost_round,
+                 resume_start) -> None:
+    """Finish one member through engine.train's solo loop: super-epochs
+    while they fit, then per-iteration remainder rounds with traced
+    eval — the exact replay semantics of the solo path, so a member
+    that leaves the fleet stays byte-identical to its solo twin."""
+    base_k, eval_spec, es_spec = plan
+    stopped = False
+    from ..snapshot import write_snapshot
+    while not stopped:
+        k_eff = min(base_k, num_boost_round - rounds[j])
+        if cfg.snapshot_freq > 0:
+            k_eff = min(k_eff, cfg.snapshot_freq
+                        - rounds[j] % cfg.snapshot_freq)
+        if k_eff < 2:
+            break
+        out = b.update_superepoch(k_eff, rounds[j], eval_spec, es_spec)
+        done = out["done"]
+        round0 = rounds[j]
+        if cfg.snapshot_freq > 0 and done == k_eff \
+                and (round0 + done) % cfg.snapshot_freq == 0:
+            try:
+                write_snapshot(b, prev, cfg, round0 + done, sig,
+                               train_set)
+            except Exception as e:
+                Log.warning(f"fleet member {j} snapshot at iteration "
+                            f"{round0 + done} failed ({e}); training "
+                            "continues")
+        es_raised = False
+        for r in range(done):
+            ev_row = [(nm, mn, float(out["evals"][r][e]), hib)
+                      for e, (_vi, nm, mn, hib) in enumerate(eval_spec)]
+            env = CallbackEnv(model=b, params=mparams,
+                              iteration=round0 + r, begin_iteration=0,
+                              end_iteration=num_boost_round,
+                              evaluation_result_list=ev_row)
+            try:
+                for cb in cbs_after:
+                    cb(env)
+            except EarlyStopException as e:
+                _apply_early_stop(b, prev, e, resume_start)
+                es_raised = True
+                extra = done - (r + 1)
+                if extra > 0:
+                    Log.warning(
+                        f"fleet member {j}: vote overshot the host "
+                        f"early stop by {extra} iteration(s); "
+                        "dropping surplus trees")
+                    b._model.drop_iterations(extra)
+                    b._sync_trees()
+                break
+        rounds[j] = resume_start + b.current_iteration
+        if es_raised or out["stump"]:
+            stopped = True
+        elif out["stop_row"] is not None:
+            Log.warning(f"fleet member {j}: early-stop vote tripped "
+                        "but the host callbacks did not; resuming")
+            b._model.clear_es_stop()
+    if not stopped and rounds[j] < num_boost_round and eval_spec:
+        b._traced_eval = True
+    for i in range(rounds[j], num_boost_round if not stopped else 0):
+        st = b.update()
+        if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
+            try:
+                write_snapshot(b, prev, cfg, i + 1, sig, train_set)
+            except Exception as e:
+                Log.warning(f"fleet member {j} snapshot at iteration "
+                            f"{i + 1} failed ({e}); training continues")
+        evals = []
+        if b._valid_names:
+            if getattr(b, "_traced_eval", False):
+                evals.extend(b.eval_valid_traced())
+            else:
+                evals.extend(b.eval_valid())
+        env = CallbackEnv(model=b, params=mparams, iteration=i,
+                          begin_iteration=0,
+                          end_iteration=num_boost_round,
+                          evaluation_result_list=evals)
+        try:
+            for cb in cbs_after:
+                cb(env)
+        except EarlyStopException as e:
+            _apply_early_stop(b, prev, e, resume_start)
+            break
+        rounds[j] = i + 1
+        if st:
+            break

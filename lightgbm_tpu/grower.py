@@ -366,7 +366,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
                                   num_slots=nslots)
         return reduce_fn(h, scales) if use_quant else reduce_fn(h)
 
-    def _quant_prepare(n, vals, feature_mask, rng_iter, n_leaves):
+    def _quant_prepare(n, vals, feature_mask, rng_iter, n_leaves,
+                       quant_seed=None):
         """Shared quantized-training entry for the strict and batched
         growers: trace-time flop/byte notes, the per-iteration GLOBAL
         scales, and the iteration-keyed stochastic quantization of the
@@ -388,7 +389,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         off = row_offset(n) if row_offset is not None else 0
         ikey = jnp.int32(0) if rng_iter is None \
             else jnp.asarray(rng_iter, jnp.int32)
-        vals = quantize_stack(vals, scales, quant, ikey, off)
+        vals = quantize_stack(vals, scales, quant, ikey, off,
+                              seed=quant_seed)
 
         def scan_expand(h, t):
             return _expand(dequantize_hist(h, scales), t)
@@ -698,7 +700,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     def grow_tree(binned, vals, feature_mask, num_bin, na_bin,
                   na_bin_part=None, is_cat=None,
                   rng_iter=None, cegb_used=None,
-                  num_bin_part=None, max_leaves=None) -> TreeArrays:
+                  num_bin_part=None, max_leaves=None,
+                  quant_seed=None) -> TreeArrays:
         trace_event("grower")
         if max_leaves is None:
             if padded:
@@ -714,7 +717,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         scan_expand = _expand
         if use_quant:
             vals, scales, scan_expand = _quant_prepare(
-                n, vals, feature_mask, rng_iter, n_leaves=2)
+                n, vals, feature_mask, rng_iter, n_leaves=2,
+                quant_seed=quant_seed)
         child_hist = _make_child_hist(n, scales)
         if na_bin_part is None:
             na_bin_part = na_bin
@@ -941,7 +945,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
     def grow_tree_batched(binned, vals, feature_mask, num_bin, na_bin,
                           na_bin_part=None, is_cat=None,
                           rng_iter=None, cegb_used=None,
-                          num_bin_part=None, max_leaves=None) -> TreeArrays:
+                          num_bin_part=None, max_leaves=None,
+                          quant_seed=None) -> TreeArrays:
         """K-splits-per-super-step grower (split_batch above).
 
         Per-leaf state arrays carry K scratch slots past the real range
@@ -964,7 +969,8 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         scan_expand = _expand
         if use_quant:
             vals, scales, scan_expand = _quant_prepare(
-                n, vals, feature_mask, rng_iter, n_leaves=2 * K)
+                n, vals, feature_mask, rng_iter, n_leaves=2 * K,
+                quant_seed=quant_seed)
         if na_bin_part is None:
             na_bin_part = na_bin
         if num_bin_part is None:

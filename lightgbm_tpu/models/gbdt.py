@@ -1238,18 +1238,21 @@ class GBDTModel:
             cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
             or cfg.neg_bagging_fraction < 1.0)
 
-    def _bagging_w(self, it) -> jax.Array:
+    def _bagging_w(self, it, seed=None) -> jax.Array:
         """In-graph bagging mask (gbdt.cpp:230-264 Bagging): the draw is
         keyed by the iteration's refresh epoch ``(it // freq) * freq`` so
         the mask is identical for ``bagging_freq`` consecutive iterations
         and identical between the per-iteration and fused-chunk paths —
         ``it`` may be a traced scan index (the GOSS pattern).  Redrawing
         per iteration instead of caching costs one [N] uniform + compare,
-        noise next to a histogram pass."""
+        noise next to a histogram pass.  ``seed`` (optional, possibly a
+        traced int32) overrides ``cfg.bagging_seed`` — the fleet trainer's
+        per-member stream; PRNGKey on a traced seed stays in-graph."""
         cfg = self.config
         n = self.num_data
         epoch = (it // cfg.bagging_freq) * cfg.bagging_freq
-        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed), epoch)
+        key = jax.random.fold_in(jax.random.PRNGKey(
+            cfg.bagging_seed if seed is None else seed), epoch)
         if self._pc > 1 and self._dist != "feature":
             # per-host independent draws (the reference seeds its bagging
             # RNG per rank the same way, gbdt.cpp bagging_rand_).
@@ -1268,11 +1271,14 @@ class GBDTModel:
         return mask.astype(jnp.float32)
 
     def _goss_vals(self, g: jax.Array, h: jax.Array,
-                   it: Optional[jax.Array] = None) -> jax.Array:
+                   it: Optional[jax.Array] = None,
+                   seed=None) -> jax.Array:
         """GOSS (goss.hpp:20-188): keep top_rate by |grad|, sample
         other_rate of the rest, amplify their weight.  ``it`` may be a
         traced iteration index (fused-chunk path); defaults to the host
-        counter so both paths draw identical per-iteration keys."""
+        counter so both paths draw identical per-iteration keys.
+        ``seed`` (optional, possibly traced) overrides
+        ``cfg.bagging_seed`` — the fleet trainer's per-member stream."""
         cfg = self.config
         multi = self._pc > 1 and self._global_counts is not None
         if multi:
@@ -1307,7 +1313,8 @@ class GBDTModel:
         is_top = absg >= thresh
         if it is None:
             it = self.iter_ + self._iter_rng_offset
-        key = jax.random.PRNGKey(cfg.bagging_seed + it)
+        key = jax.random.PRNGKey(
+            (cfg.bagging_seed if seed is None else seed) + it)
         if self._pc > 1 and not multi and self._dist != "feature":
             # multi-process WITHOUT the mesh data-parallel bookkeeping
             # (caller-supplied hist_reduce hook): keep per-rank independent
@@ -1856,20 +1863,27 @@ class GBDTModel:
                 type(self.objective).__name__, names, scal,
                 len(self.valid_sets), tuple(eval_spec), repr(es_spec))
 
-    def _build_superepoch(self, eval_spec, es_spec, obj_parts):
-        """Compile the super-epoch program: ONE ``lax.scan`` over k FULL
-        boosting iterations — gradients, grow, score update, valid-set
-        traversal+scoring, traced metric eval, early-stop vote — with
-        zero host syncs inside.  The per-iteration tree math is the
-        fused-chunk ``one_iter`` body verbatim (same RNG streams, same
-        finite-guard policies, same dead-gating), extended with the
+    def _build_superepoch_body(self, eval_spec, es_spec, obj_parts,
+                               member_args=False):
+        """Build the UNJITTED super-epoch scan body: ONE ``lax.scan``
+        over k FULL boosting iterations — gradients, grow, score update,
+        valid-set traversal+scoring, traced metric eval, early-stop vote
+        — with zero host syncs inside.  The per-iteration tree math is
+        the fused-chunk ``one_iter`` body verbatim (same RNG streams,
+        same finite-guard policies, same dead-gating), extended with the
         traced eval tail; model data arrays ride as arguments so keyable
-        configs share the compile process-wide (``_SE_CACHE``)."""
-        import functools
+        configs share the compile process-wide (``_SE_CACHE``).
+
+        ``member_args=True`` is the fleet trainer's form: the trailing
+        ``mrng = (learning_rate, sampling_seed, quant_seed)`` operand
+        replaces the corresponding baked constants so the SAME body can
+        be ``jax.vmap``-ped over a member axis (fleet/trainer.py) with
+        per-member streams.  Feeding a value as an argument instead of a
+        closure constant does not change a single emitted arithmetic op,
+        which is what keeps fleet members byte-identical to solo runs."""
         from ..metrics import traced_metric_fn
         from ..obs.flops import (eval_flops_bytes, note_traced,
                                  score_update_flops_bytes)
-        from ..utils.compile_cache import trace_event
 
         cfg = self.config
         grow = make_grower(
@@ -1909,6 +1923,7 @@ class GBDTModel:
         bagging_w = self._bagging_w if use_bag else None
         rng_iter_kw = (self._extra_trees or self._bynode_masked
                        or self._quant is not None)
+        use_quant_seed = member_args and self._quant is not None
         ic = self._ic_grow
         fin_freq = cfg.finite_check_freq
         fin_policy = cfg.finite_check_policy
@@ -1941,13 +1956,15 @@ class GBDTModel:
             es_hib = jnp.asarray(
                 np.asarray([hib for (_, _, _, hib) in eval_spec], bool))
 
-        # the scan body is defined inside the jitted wrapper because the
-        # objective must first be assembled from the array arguments
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def sepoch(score, vscores, es_state, fmasks, iters, eiters,
-                   cuse0, ml, binned, nb, na, na_bin, obj_arrs,
-                   valid_ops):
-            trace_event("superepoch")
+        # the scan body assembles the objective from the array arguments
+        # (process-level program sharing keeps data out of the closure)
+        def sepoch_body(score, vscores, es_state, fmasks, iters, eiters,
+                        cuse0, ml, binned, nb, na, na_bin, obj_arrs,
+                        valid_ops, mrng=None):
+            if member_args:
+                lr_, samp_seed, q_seed = mrng
+            else:
+                lr_, samp_seed, q_seed = lr, None, None
             obj = copy.copy(obj_template)
             for nm, arr in zip(arr_names, obj_arrs):
                 setattr(obj, nm, arr)
@@ -1963,15 +1980,17 @@ class GBDTModel:
                     h = jnp.nan_to_num(h, nan=0.0, posinf=_FINITE_CLAMP,
                                        neginf=0.0)
                 if use_goss:
-                    w = goss_vals(g, h, it)
+                    w = goss_vals(g, h, it, seed=samp_seed)
                 elif use_bag:
-                    w = bagging_w(it)
+                    w = bagging_w(it, seed=samp_seed)
                 else:
                     w = jnp.ones_like(g)
                 vals = jnp.stack([g * w, h * w, w], axis=1)
                 kw = {"is_cat": ic} if ic is not None else {}
                 if rng_iter_kw:
                     kw["rng_iter"] = it
+                if use_quant_seed:
+                    kw["quant_seed"] = q_seed
                 if use_cegb:
                     kw["cegb_used"] = cuse
                 if leaf_padded:
@@ -1987,9 +2006,9 @@ class GBDTModel:
                 if fin_freq > 0 and fin_policy == "clamp":
                     lv = jnp.nan_to_num(
                         arrays.leaf_value, nan=0.0, posinf=_FINITE_CLAMP,
-                        neginf=-_FINITE_CLAMP) * lr
+                        neginf=-_FINITE_CLAMP) * lr_
                 else:
-                    lv = arrays.leaf_value * lr
+                    lv = arrays.leaf_value * lr_
                 if fin_freq > 0 and fin_policy != "clamp":
                     check_now = ((it + 1) % fin_freq) == 0
                     fin = (jnp.isfinite(g).all() & jnp.isfinite(h).all()
@@ -2070,7 +2089,88 @@ class GBDTModel:
             return (score, vscores, (esb, esi, esh, stop), out, bad,
                     stops, vstack)
 
+        return sepoch_body
+
+    def _build_superepoch(self, eval_spec, es_spec, obj_parts):
+        """Compile the (solo) super-epoch program: the scan body from
+        ``_build_superepoch_body`` under one jit with donated carries."""
+        import functools
+        from ..utils.compile_cache import trace_event
+        body = self._build_superepoch_body(eval_spec, es_spec, obj_parts)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def sepoch(score, vscores, es_state, fmasks, iters, eiters,
+                   cuse0, ml, binned, nb, na, na_bin, obj_arrs,
+                   valid_ops):
+            trace_event("superepoch")
+            return body(score, vscores, es_state, fmasks, iters, eiters,
+                        cuse0, ml, binned, nb, na, na_bin, obj_arrs,
+                        valid_ops)
+
         return sepoch
+
+    def build_fleet_superepoch(self, eval_spec, es_spec, obj_parts):
+        """Compile the FLEET super-epoch program (fleet/trainer.py): the
+        same scan body as ``_build_superepoch``, ``jax.vmap``-ped over a
+        leading member axis of every member-varying operand — scores,
+        valid scores, ES state, feature masks, iteration indices, leaf
+        budgets, and the per-member ``(lr, sampling seed, quant seed)``
+        stream block — while the binned matrix, NA table, objective
+        arrays and valid-set operands stay shared (in_axes=None).  N
+        forests grow inside ONE compiled program with ONE trace
+        (``fleet_superepoch``); per-member early-stop flags mask (not
+        branch) finished members, so lanes at different progress points
+        coexist without retracing."""
+        import functools
+        from ..utils.compile_cache import trace_event
+        body = self._build_superepoch_body(eval_spec, es_spec, obj_parts,
+                                           member_args=True)
+        vbody = jax.vmap(body, in_axes=(0, 0, 0, 0, 0, 0, None, 0,
+                                        None, None, None, None, None,
+                                        None, 0))
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def fleet_sepoch(score, vscores, es_state, fmasks, iters,
+                         eiters, cuse0, ml, binned, nb, na, na_bin,
+                         obj_arrs, valid_ops, mrng):
+            trace_event("fleet_superepoch")
+            return vbody(score, vscores, es_state, fmasks, iters,
+                         eiters, cuse0, ml, binned, nb, na, na_bin,
+                         obj_arrs, valid_ops, mrng)
+
+        return fleet_sepoch
+
+    def fleet_superepoch_fn(self, eval_spec, es_spec, obj_parts,
+                            n_members: int):
+        """The FLEET super-epoch program with process-level sharing
+        (fleet/trainer.py): same ``_SE_CACHE`` discipline as the solo
+        path, keyed by the solo sharing key plus the member count — a
+        warmed-up process redispatches the same fleet shape without
+        recompiling.  Unkeyable state (bagging/GOSS bound methods etc.)
+        falls back to this model's private ``_fused_cache``."""
+        key = self._superepoch_key(eval_spec, es_spec, obj_parts)
+        if key is not None:
+            key = ("fleet", int(n_members)) + key
+            with _SE_CACHE_LOCK:
+                fn = _SE_CACHE.get(key)
+                if fn is not None:
+                    _SE_CACHE.move_to_end(key)
+            if fn is None:
+                fn = self.build_fleet_superepoch(eval_spec, es_spec,
+                                                 obj_parts)
+                with _SE_CACHE_LOCK:
+                    _SE_CACHE[key] = fn
+                    while len(_SE_CACHE) > _SE_CACHE_MAX:
+                        _SE_CACHE.popitem(last=False)
+            return fn
+        pk = ("fleet_superepoch", int(n_members), tuple(eval_spec),
+              repr(es_spec))
+        fn = self._fused_cache.get(pk)
+        if fn is None:
+            fn = self.build_fleet_superepoch(eval_spec, es_spec,
+                                             obj_parts)
+            self._fused_cache[pk] = fn
+        return fn
 
     def train_superepoch(self, k: int, es_it0: int, eval_spec=(),
                          es_spec=None) -> dict:
@@ -2093,6 +2193,58 @@ class GBDTModel:
 
         Returns ``{"evals": f32 [done, E], "done": int, "stump": bool,
         "stop_row": Optional[int]}``."""
+        cfg = self.config
+        start_iter = self.iter_
+        init0, _sp = self._se_begin(k, len(eval_spec))
+        obs = self._obs
+        obj_parts = self._obj_array_attrs()
+        key = self._superepoch_key(eval_spec, es_spec, obj_parts)
+        fn = None
+        if key is not None:
+            with _SE_CACHE_LOCK:
+                fn = _SE_CACHE.get(key)
+                if fn is not None:
+                    _SE_CACHE.move_to_end(key)
+            if fn is None:
+                fn = self._build_superepoch(eval_spec, es_spec, obj_parts)
+                with _SE_CACHE_LOCK:
+                    _SE_CACHE[key] = fn
+                    while len(_SE_CACHE) > _SE_CACHE_MAX:
+                        _SE_CACHE.popitem(last=False)
+        else:
+            pk = ("superepoch", tuple(eval_spec), repr(es_spec))
+            fn = self._fused_cache.get(pk)
+            if fn is None:
+                fn = self._build_superepoch(eval_spec, es_spec, obj_parts)
+                self._fused_cache[pk] = fn
+
+        (fmasks, iters, eiters, cuse0, es_state, vscores,
+         valid_ops) = self._se_operands(k, es_it0, len(eval_spec))
+        obj_arrs = obj_parts[1] if obj_parts is not None else ()
+        (self.score, new_vsc, es_out, stacked, bad_flags, stops_dev,
+         vstack) = fn(self.score, vscores, es_state, fmasks, iters,
+                      eiters, cuse0, jnp.int32(cfg.num_leaves),
+                      self.binned_dev, self._nb_grow, self._na_grow,
+                      self.na_bin_dev, obj_arrs, valid_ops)
+        self._se_absorb(new_vsc, es_out)
+        ev_dev = self._se_eval_block(vstack, eval_spec, k)
+        # the one sync per super-epoch (tree records + finite-guard
+        # flags + eval block + stop flags)
+        host, bad_host, ev_host, stops_np = self._eget(
+            (stacked, bad_flags, ev_dev, stops_dev), "fused_fetch")
+        if obs is not None:
+            _sp.end()
+            if obs.profiler is not None:
+                obs.profiler.on_iter_end(start_iter + k - 1)
+        return self._se_ingest(host, stacked, bad_host, stops_np,
+                               ev_host, k, start_iter, init0,
+                               len(eval_spec))
+
+    def _se_begin(self, k: int, n_entries: int):
+        """Super-epoch prologue (shared with fleet/trainer.py): peer
+        liveness, fusability guard, the first-iteration
+        boost_from_average bias applied to train AND valid scores, and
+        the obs span.  Returns ``(init0, span_or_None)``."""
         if self._elastic is not None:
             self._elastic.check_peers()
         if not self._fusable_config():
@@ -2115,94 +2267,88 @@ class GBDTModel:
                     vds, vb, vs = self.valid_sets[vi]
                     self.valid_sets[vi] = (vds, vb,
                                            vs + jnp.float32(init0))
-
         obs = self._obs
+        _sp = None
         if obs is not None:
             _sp = obs.tracer.span("train_superepoch", n_iters=k,
                                   iteration=start_iter,
-                                  n_evals=len(eval_spec))
+                                  n_evals=n_entries)
             if obs.profiler is not None:
                 for it in range(start_iter, start_iter + k):
                     obs.profiler.on_iter_begin(it)
+        return init0, _sp
 
-        obj_parts = self._obj_array_attrs()
-        key = self._superepoch_key(eval_spec, es_spec, obj_parts)
-        fn = None
-        if key is not None:
-            with _SE_CACHE_LOCK:
-                fn = _SE_CACHE.get(key)
-                if fn is not None:
-                    _SE_CACHE.move_to_end(key)
-            if fn is None:
-                fn = self._build_superepoch(eval_spec, es_spec, obj_parts)
-                with _SE_CACHE_LOCK:
-                    _SE_CACHE[key] = fn
-                    while len(_SE_CACHE) > _SE_CACHE_MAX:
-                        _SE_CACHE.popitem(last=False)
-        else:
-            pk = ("superepoch", tuple(eval_spec), repr(es_spec))
-            fn = self._fused_cache.get(pk)
-            if fn is None:
-                fn = self._build_superepoch(eval_spec, es_spec, obj_parts)
-                self._fused_cache[pk] = fn
-
+    def _se_operands(self, k: int, es_it0: int, n_entries: int):
+        """The epoch's device operands (shared with fleet/trainer.py).
+        Draws the k stateful feature-fraction masks — call EXACTLY once
+        per dispatched epoch, in member order, or the host RNG stream
+        diverges from the solo run."""
+        cfg = self.config
         if cfg.feature_fraction < 1.0:
             fmasks = jnp.asarray(
                 np.stack([self._feature_mask() for _ in range(k)]))
         else:
             fmasks = jnp.ones((k, self.num_features), bool)
-        it0 = start_iter + self._iter_rng_offset
+        it0 = self.iter_ + self._iter_rng_offset
         iters = jnp.arange(it0, it0 + k, dtype=jnp.int32)
         eiters = jnp.arange(es_it0, es_it0 + k, dtype=jnp.int32)
         cuse0 = jnp.asarray(self._cegb_state.used) \
             if self._cegb_state is not None \
             else jnp.zeros(1, bool)
-        E = len(eval_spec)
         es_state = self._es_dev
         if es_state is None:
-            es_state = (jnp.zeros(E, jnp.float32),
-                        jnp.zeros(E, jnp.int32), jnp.zeros(E, bool),
+            es_state = (jnp.zeros(n_entries, jnp.float32),
+                        jnp.zeros(n_entries, jnp.int32),
+                        jnp.zeros(n_entries, bool),
                         jnp.bool_(False))
         vscores = tuple(vs for _, _, vs in self.valid_sets)
         valid_ops = tuple(
             (self.valid_sets[vi][1],) + self._se_valid_dev(vi)
             for vi in range(len(self.valid_sets)))
-        obj_arrs = obj_parts[1] if obj_parts is not None else ()
-        (self.score, new_vsc, es_out, stacked, bad_flags, stops_dev,
-         vstack) = fn(self.score, vscores, es_state, fmasks, iters,
-                      eiters, cuse0, jnp.int32(cfg.num_leaves),
-                      self.binned_dev, self._nb_grow, self._na_grow,
-                      self.na_bin_dev, obj_arrs, valid_ops)
+        return (fmasks, iters, eiters, cuse0, es_state, vscores,
+                valid_ops)
+
+    def _se_absorb(self, new_vsc, es_out) -> None:
+        """Store the epoch's updated valid scores + ES vote state."""
         for vi in range(len(self.valid_sets)):
             vds, vb, _ = self.valid_sets[vi]
             self.valid_sets[vi] = (vds, vb, new_vsc[vi])
         self._es_dev = es_out
-        # reported eval values: the SAME jitted program the per-iteration
-        # fused_eval path runs (metrics.build_traced_eval), applied to
-        # each iteration's stacked valid-score row — in-scan reductions
-        # can fuse (and round the last ulp) differently than the
-        # standalone program, so re-evaluating through the shared program
-        # is what makes super-epoch record_evals bit-identical to
-        # per-iteration.  The k dispatches are async; no host sync here
-        if E:
-            teval = self._teval_fn(eval_spec)
-            t_ops = tuple(self._se_valid_dev(vi)
-                          for vi in range(len(self.valid_sets)))
-            ev_dev = jnp.stack([
-                teval(tuple(vstack[vi][j]
-                            for vi in range(len(vstack))), t_ops)
-                for j in range(k)])
-        else:
-            ev_dev = jnp.zeros((k, 0), jnp.float32)
-        # the one sync per super-epoch (tree records + finite-guard
-        # flags + eval block + stop flags)
-        host, bad_host, ev_host, stops_np = self._eget(
-            (stacked, bad_flags, ev_dev, stops_dev), "fused_fetch")
-        if obs is not None:
-            _sp.end()
-            if obs.profiler is not None:
-                obs.profiler.on_iter_end(start_iter + k - 1)
 
+    def _se_eval_block(self, vstack, eval_spec, k: int, teval=None):
+        """Reported eval values: the SAME jitted program the
+        per-iteration fused_eval path runs (metrics.build_traced_eval),
+        applied to each iteration's stacked valid-score row — in-scan
+        reductions can fuse (and round the last ulp) differently than
+        the standalone program, so re-evaluating through the shared
+        program is what makes super-epoch record_evals bit-identical to
+        per-iteration.  The k dispatches are async; no host sync here.
+        ``teval`` (optional) supplies the program — the fleet trainer
+        passes member 0's so ALL members report through ONE trace."""
+        if not len(eval_spec):
+            return jnp.zeros((k, 0), jnp.float32)
+        if teval is None:
+            teval = self._teval_fn(eval_spec)
+        t_ops = tuple(self._se_valid_dev(vi)
+                      for vi in range(len(self.valid_sets)))
+        return jnp.stack([
+            teval(tuple(vstack[vi][j]
+                        for vi in range(len(vstack))), t_ops)
+            for j in range(k)])
+
+    def _se_ingest(self, host, stacked, bad_host, stops_np, ev_host,
+                   k: int, start_iter: int, init0: float,
+                   n_entries: int) -> dict:
+        """Replay the fetched epoch block into host/device tree state:
+        one ``Tree.from_arrays`` + ``_DeviceTree`` per row, finite-guard
+        stub handling, CEGB feature marking, and the obs/bbox epoch
+        accounting.  Shared with fleet/trainer.py, which slices each
+        member's rows out of the [N, k, ...] fleet fetch and ingests
+        them through this exact path."""
+        cfg = self.config
+        obs = self._obs
+        E = n_entries
+        it0 = start_iter + self._iter_rng_offset
         lr = self.learning_rate
         stopped = False
         stop_row = None

@@ -44,6 +44,8 @@ one-hot never materializes).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import threading
 from typing import Dict, NamedTuple, Tuple
 
@@ -286,6 +288,31 @@ def train_hist_flops_per_iter(n_rows: int, n_feat: int, num_bins: int,
 _TRACED_LOCK = threading.Lock()
 _TRACED: Dict[str, FlopSite] = {}
 
+# ambient member-axis multiplier (fleet/trainer.py): while a fleet
+# program traces, every site note fires ONCE (vmap traces the body once)
+# but the compiled program executes it N times per dispatch — scale the
+# note so perf.* / MFU stay truthful for the whole fleet.  A contextvar
+# (not a global) so a concurrent solo trace in another thread is not
+# contaminated.
+_MEMBER_AXIS: "contextvars.ContextVar[int]" = contextvars.ContextVar(
+    "lgbtpu_member_axis", default=1)
+
+
+def _member_scale() -> int:
+    return _MEMBER_AXIS.get()
+
+
+@contextlib.contextmanager
+def member_axis(n: int):
+    """Scale every ``note_traced`` fired inside the context by ``n`` —
+    wrap the fleet program's trace/dispatch so the process-wide traced
+    ledger accounts all N members' work, not one lane's."""
+    tok = _MEMBER_AXIS.set(max(1, int(n)))
+    try:
+        yield
+    finally:
+        _MEMBER_AXIS.reset(tok)
+
 
 def note_traced(site: str, flops: int, hbm_bytes: int,
                 phase: str = "", cadence: str = "step") -> None:
@@ -293,10 +320,15 @@ def note_traced(site: str, flops: int, hbm_bytes: int,
     inside jitted function bodies, so it fires once per fresh trace and
     overwrites idempotently on retrace — the latest traced shapes win
     (the process-wide view; per-model attribution goes through the
-    driver's FlopLedger, which never depends on jit-cache state)."""
+    driver's FlopLedger, which never depends on jit-cache state).
+    Under :func:`member_axis` the note is scaled by the fleet's member
+    count — vmap traces the body once but runs it N-wide."""
+    scale = _member_scale()
     with _TRACED_LOCK:
-        _TRACED[site] = FlopSite(site=site, phase=phase, flops=int(flops),
-                                 hbm_bytes=int(hbm_bytes), cadence=cadence)
+        _TRACED[site] = FlopSite(site=site, phase=phase,
+                                 flops=int(flops) * scale,
+                                 hbm_bytes=int(hbm_bytes) * scale,
+                                 cadence=cadence)
 
 
 def traced_sites() -> Dict[str, FlopSite]:

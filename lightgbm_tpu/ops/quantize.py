@@ -40,6 +40,7 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
+import numpy as np
 
 
 class QuantSpec(NamedTuple):
@@ -73,13 +74,20 @@ def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def counter_uniform(row_id: jnp.ndarray, n_chan: int, iter_key,
-                    seed: int) -> jnp.ndarray:
+                    seed) -> jnp.ndarray:
     """[N, n_chan] U[0,1) keyed by (global row id, channel, iteration,
     seed) — identical values for a row regardless of which shard holds
     it.  Top 24 bits only, so the f32 conversion is exact and the
-    result is strictly < 1 (floor(x + u) can never over-round)."""
+    result is strictly < 1 (floor(x + u) can never over-round).
+    ``seed`` may be a traced int32 (the fleet's per-member rounding
+    seed rides the vmapped member axis); the uint32 product below is
+    mod-2^32 identical to the historic host-side ``int(seed) *
+    2654435761 & 0xFFFFFFFF`` expression."""
+    if isinstance(seed, (int, np.integer)):
+        seed = np.uint32(int(seed) & 0xFFFFFFFF)
     k = _fmix32(jnp.asarray(iter_key).astype(jnp.uint32)
-                ^ jnp.uint32((int(seed) * 2654435761) & 0xFFFFFFFF))
+                ^ (jnp.asarray(seed).astype(jnp.uint32)
+                   * jnp.uint32(2654435761)))
     chan = jnp.arange(n_chan, dtype=jnp.uint32)
     h = _fmix32(row_id.astype(jnp.uint32)[:, None]
                 * jnp.uint32(0x9E3779B9)
@@ -100,15 +108,18 @@ def quant_scales(vals: jnp.ndarray, qmax: int,
 
 def quantize_stack(vals: jnp.ndarray, scales: jnp.ndarray,
                    spec: QuantSpec, iter_key,
-                   row_offset) -> jnp.ndarray:
+                   row_offset, seed=None) -> jnp.ndarray:
     """[N, C] f32 -> [N, C] int8/int16 with the iteration's shared
     scales.  ``row_offset`` is this shard's global row offset (0 for
-    serial / replicated-row learners)."""
+    serial / replicated-row learners).  ``seed`` (optional, possibly
+    traced) overrides ``spec.seed`` — the fleet trainer's per-member
+    rounding seed, which cannot live in the static spec."""
     x = vals / scales[None, :]
     if spec.stochastic:
         rows = jnp.asarray(row_offset, jnp.int32) \
             + jnp.arange(vals.shape[0], dtype=jnp.int32)
-        u = counter_uniform(rows, vals.shape[1], iter_key, spec.seed)
+        u = counter_uniform(rows, vals.shape[1], iter_key,
+                            spec.seed if seed is None else seed)
         q = jnp.floor(x + u)
     else:
         q = jnp.round(x)

@@ -290,8 +290,8 @@ def gated_promote(registry, *, snapshot: Optional[str] = None,
                   batches: Optional[List[np.ndarray]] = None,
                   eval_set: Optional[Tuple[np.ndarray, np.ndarray]] = None,
                   metrics=None, version: Optional[str] = None,
-                  lineage_decay: Optional[float] = None
-                  ) -> Tuple[str, Dict]:
+                  lineage_decay: Optional[float] = None,
+                  activate: bool = True) -> Tuple[str, Dict]:
     """Two-stage gated promotion into a ``ModelRegistry`` — the ONLY
     sanctioned way a continual candidate starts serving.
 
@@ -305,7 +305,13 @@ def gated_promote(registry, *, snapshot: Optional[str] = None,
     (in-flight requests finish on the incumbent, the hot-swap
     contract).  Anything fails -> the candidate is unloaded (it never
     served a request) and :class:`GateFailure` raises for the caller to
-    quarantine.  Returns ``(version, gate_report)``."""
+    quarantine.  Returns ``(version, gate_report)``.
+
+    ``activate=False`` runs the FULL gate but leaves the passed
+    candidate resident without flipping the registry's current pointer
+    — the per-segment promote (fleet serving): the caller routes a
+    segment at the returned version instead of making it the
+    default."""
     cfg = cfg if cfg is not None else Config({})
     faultinject.check("continual_promote")
     from ..serve.registry import NoModelError
@@ -343,7 +349,8 @@ def gated_promote(registry, *, snapshot: Optional[str] = None,
             report["probe"] = probe
             if not probe["ok"]:
                 raise GateFailure("shadow_probe", probe["reason"])
-        registry.activate(version)
+        if activate:
+            registry.activate(version)
         report["gate_s"] = round(time.perf_counter() - t0, 6)
         if metrics is not None:
             metrics.histogram("continual.gate_seconds").observe(

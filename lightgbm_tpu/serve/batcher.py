@@ -118,15 +118,19 @@ class PredictionFuture:
 
 
 class _Item:
-    __slots__ = ("rows", "future", "probe")
+    __slots__ = ("rows", "future", "probe", "key")
 
     def __init__(self, rows: np.ndarray, future: PredictionFuture,
-                 probe: bool = False):
+                 probe: bool = False, key=None):
         self.rows = rows
         self.future = future
         # this request claimed the breaker's half-open probe slot: if it
         # leaves without a batch outcome the slot must be released
         self.probe = probe
+        # routing key (e.g. a fleet segment): requests with different
+        # keys never share a batch — each key may resolve to a
+        # different model
+        self.key = key
 
 
 class MicroBatcher:
@@ -188,7 +192,8 @@ class MicroBatcher:
 
     # -- client side -------------------------------------------------------
     def submit(self, rows: np.ndarray,
-               deadline_ms: Optional[float] = None) -> PredictionFuture:
+               deadline_ms: Optional[float] = None,
+               key=None) -> PredictionFuture:
         """Enqueue one request; raises :class:`BacklogFull` when the
         bounded queue cannot take it, :class:`CircuitOpen` while the
         serving circuit is open, and :class:`DeadlineExceeded` when the
@@ -251,7 +256,7 @@ class MicroBatcher:
                 # before enqueue: breaker-rejected work never consumes
                 # queue capacity or waits out a doomed retry cycle
                 probe = self.breaker.check_admission()
-            self._queue.append(_Item(rows, fut, probe=probe))
+            self._queue.append(_Item(rows, fut, probe=probe, key=key))
             self._depth_rows += n
             if self.metrics is not None:
                 self.metrics.gauge("serve.queue_depth").set(
@@ -369,10 +374,13 @@ class MicroBatcher:
                 nxt = len(head.rows)
                 if batch and (rows + nxt > self.max_batch
                               or head.rows.shape[1]
-                              != batch[0].rows.shape[1]):
+                              != batch[0].rows.shape[1]
+                              or head.key != batch[0].key):
                     # width mismatch (a request sized for a different
-                    # model width): never concatenated into this batch —
-                    # it opens the NEXT batch and fails alone if invalid
+                    # model width) or a different routing key (a
+                    # request bound for a different model): never
+                    # concatenated into this batch — it opens the NEXT
+                    # batch and fails alone if invalid
                     break
                 item = self._queue.pop(0)
                 batch.append(item)
@@ -434,10 +442,19 @@ class MicroBatcher:
             # worker thread
             rows = (batch[0].rows if len(batch) == 1
                     else np.concatenate([i.rows for i in batch], axis=0))
-            out = retry_call(self.predict_fn, rows,
-                             policy=self.retry_policy,
-                             classify=is_retryable_device_error,
-                             label="serve.predict")
+            if batch[0].key is not None:
+                # keyed batch: the whole batch shares one routing key
+                # (collect never mixes keys), delivered to predict_fn
+                # so it can resolve the routed model
+                out = retry_call(self.predict_fn, rows, batch[0].key,
+                                 policy=self.retry_policy,
+                                 classify=is_retryable_device_error,
+                                 label="serve.predict")
+            else:
+                out = retry_call(self.predict_fn, rows,
+                                 policy=self.retry_policy,
+                                 classify=is_retryable_device_error,
+                                 label="serve.predict")
             outputs, info = out if isinstance(out, tuple) else (out, {})
             outputs = np.asarray(outputs)
         except BaseException as e:

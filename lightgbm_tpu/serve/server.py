@@ -81,8 +81,9 @@ class Server:
     own loop thread.
 
     Lock contract (tools/analyze/check_races.py):
-        _lock guards: _versions_loaded, _closed
+        _lock guards: _versions_loaded, _closed, _seg_labels
         registry type: lightgbm_tpu/serve/registry.py:ModelRegistry
+        router type: lightgbm_tpu/fleet/router.py:SegmentRouter
         batcher type: lightgbm_tpu/serve/batcher.py:MicroBatcher
         breaker type: lightgbm_tpu/serve/breaker.py:ServeBreaker
 
@@ -119,6 +120,14 @@ class Server:
         # Written from HTTP handler threads (reload/promote) — guarded
         self._lock = threading.Lock()
         self._versions_loaded = 0
+        # segment -> version routing over the co-resident registry
+        # (fleet serving, docs/Fleet.md): per-request ``segment`` keys
+        # resolve here; unknown keys fall back to the default segment
+        from ..fleet.router import SegmentRouter
+        self.router = SegmentRouter(cfg.serve_default_segment)
+        # distinct segment labels already granted their own metric
+        # series (bounded by serve_metrics_max_versions; _seg_label)
+        self._seg_labels: set = set()
         model_file = model_file or (cfg.input_model or None)
         if booster is not None or model_file or model_str:
             self.registry.load(model_file=model_file,
@@ -170,14 +179,58 @@ class Server:
             meta={"surface": "serve"})
 
     # -- batch execution (worker thread) -----------------------------------
-    def _predict_batch(self, rows: np.ndarray) -> Tuple[np.ndarray, dict]:
+    def _resolve_served(self, segment):
+        """The ServedModel for a batch's routing key: the router maps
+        ``segment`` to a registry version (default-segment fallback for
+        unknown keys); an unrouted/evicted resolution serves the
+        registry's current model.  ``segment=None`` (unkeyed request)
+        is exactly the pre-fleet path."""
+        if segment is None:
+            return self.registry.current()
+        ver, fell_back = self.router.resolve(segment)
+        if fell_back:
+            self.metrics.counter("serve.segment_fallbacks").inc()
+        if ver is None:
+            return self.registry.current()
+        try:
+            return self.registry.get(ver)
+        except KeyError:
+            # the routed version was unloaded/evicted underneath the
+            # assignment: drop the stale routes and serve current —
+            # a routing gap degrades to the default model, never a 500
+            for seg in self.router.drop_version(ver):
+                Log.warning(f"serve: segment {seg!r} pointed at "
+                            f"unloaded model {ver}; rerouting to "
+                            "default")
+            self.metrics.counter("serve.segment_fallbacks").inc()
+            return self.registry.current()
+
+    def _seg_label(self, segment) -> str:
+        """Bounded-cardinality metric label for a segment: the first
+        ``serve_metrics_max_versions`` distinct segments keep their own
+        label; the rest aggregate under ``__other__`` so an unbounded
+        key space cannot bloat the exposition."""
+        cap = self.config.serve_metrics_max_versions
+        if cap <= 0:
+            return "__other__"
+        s = str(segment)
+        with self._lock:
+            if s in self._seg_labels:
+                return s
+            if len(self._seg_labels) < cap:
+                self._seg_labels.add(s)
+                return s
+        return "__other__"
+
+    def _predict_batch(self, rows: np.ndarray,
+                       segment=None) -> Tuple[np.ndarray, dict]:
         from ..utils import faultinject
         t0 = time.perf_counter() if self.recorder is not None else 0.0
         try:
             faultinject.check("serve_batch")   # chaos site (soak harness)
-            served = self.registry.current()   # resolved per batch:
-            # requests already in this batch finish on it even if a
-            # reload lands now
+            served = self._resolve_served(segment)  # resolved per
+            # batch: requests already in this batch finish on it even
+            # if a reload or segment reassignment lands now
             served.begin_request()             # residency-cap eviction
             # skips versions with requests in flight (registry.py)
             try:
@@ -217,27 +270,42 @@ class Server:
             self.recorder.record(rows=int(len(rows)),
                                  model_version=served.version,
                                  dur_s=round(time.perf_counter() - t0, 6))
-        return np.asarray(out), {"model_version": served.version}
+        info = {"model_version": served.version}
+        if segment is not None:
+            info["segment"] = str(segment)
+            self.metrics.counter(
+                "serve.segment_rows",
+                segment=self._seg_label(segment)).inc(len(rows))
+        return np.asarray(out), info
 
     # -- client surface ----------------------------------------------------
     def predict(self, rows, timeout: Optional[float] = None,
-                deadline_ms: Optional[float] = None) -> np.ndarray:
+                deadline_ms: Optional[float] = None,
+                segment: Optional[str] = None) -> np.ndarray:
         """Predict through the micro-batching queue; blocks for the
         result.  Raises :class:`~.batcher.BacklogFull` under
         backpressure, :class:`~.breaker.CircuitOpen` while the breaker
         is open, :class:`~.batcher.DeadlineExceeded` past the
-        deadline."""
-        return self.submit(rows, deadline_ms=deadline_ms).result(timeout)
+        deadline.  ``segment`` routes to that segment's promoted model
+        version (fleet serving; unknown keys fall back to the default
+        segment)."""
+        return self.submit(rows, deadline_ms=deadline_ms,
+                           segment=segment).result(timeout)
 
-    def submit(self, rows, deadline_ms: Optional[float] = None):
+    def submit(self, rows, deadline_ms: Optional[float] = None,
+               segment: Optional[str] = None):
         """Enqueue and return the :class:`PredictionFuture` (the
         non-blocking form of :meth:`predict`).  ``deadline_ms``
-        overrides the ``serve_deadline_ms`` default for this request."""
+        overrides the ``serve_deadline_ms`` default for this request;
+        ``segment`` is the fleet routing key — requests with different
+        segments never share a device batch (they may resolve to
+        different models)."""
         span = (self.tracer.span("serve.request", rows=len(rows))
                 if self.tracer is not None else None)
         try:
-            return self.batcher.submit(np.asarray(rows, np.float64),
-                                       deadline_ms=deadline_ms)
+            return self.batcher.submit(
+                np.asarray(rows, np.float64), deadline_ms=deadline_ms,
+                key=None if segment is None else str(segment))
         finally:
             # rejected submissions (breaker open, backlog, deadline,
             # draining) are exactly the events an outage trace needs —
@@ -282,7 +350,8 @@ class Server:
     def promote(self, snapshot: Optional[str] = None,
                 model_file: Optional[str] = None,
                 expected_sha256: Optional[str] = None,
-                version: Optional[str] = None):
+                version: Optional[str] = None,
+                segment: Optional[str] = None):
         """GATED promotion (``POST /promote``): unlike :meth:`reload`,
         the candidate activates only after the two-stage gate — SHA
         verification + engine self-check, then the shadow-traffic
@@ -290,14 +359,21 @@ class Server:
         (pipeline/continual.py ``gated_promote``).  A refusal raises
         :class:`~..pipeline.continual.GateFailure`, counts
         ``continual.rollbacks``, and leaves the incumbent serving —
-        the candidate never takes a request."""
+        the candidate never takes a request.
+
+        ``segment`` scopes the promotion to one routing key: the
+        candidate runs the SAME full gate but on success only that
+        segment is re-pointed at it (fleet router) — the default model
+        and every other segment keep serving what they served.  A
+        refusal likewise leaves the segment's previous assignment
+        untouched."""
         from ..pipeline.continual import GateFailure, gated_promote
         try:
             v, gate = gated_promote(
                 self.registry, snapshot=snapshot, model_file=model_file,
                 expected_sha256=expected_sha256, cfg=self.config,
                 batches=self.shadow_batches(), metrics=self.metrics,
-                version=version)
+                version=version, activate=segment is None)
         except (GateFailure, ArtifactVerificationError):
             # a REFUSED candidate is a rollback; a malformed operator
             # call (bad args, missing file) is not
@@ -306,7 +382,13 @@ class Server:
         with self._lock:
             self._versions_loaded += 1
         self.metrics.counter("continual.published").inc()
-        Log.info(f"serve: gated promotion activated model {v}")
+        if segment is not None:
+            self.router.assign(segment, v)
+            self.metrics.counter("serve.segment_promotes").inc()
+            Log.info(f"serve: gated promotion routed segment "
+                     f"{segment!r} -> model {v}")
+        else:
+            Log.info(f"serve: gated promotion activated model {v}")
         return v, gate
 
     def freshness(self) -> dict:
@@ -469,6 +551,17 @@ class Server:
                         snap[f"perf.forest.{k}"] = v
         except NoModelError:
             pass
+        # segment routing table — bounded by the same label cap as the
+        # per-segment counters so a hostile key stream can't bloat the
+        # export (overflow collapses into a count, not a key list)
+        segs = self.router.snapshot()
+        if segs:
+            cap = max(0, int(self.config.serve_metrics_max_versions))
+            items = sorted(segs.items())
+            snap["serve.segments"] = dict(items[:cap])
+            if len(items) > cap:
+                snap["serve.segments_overflow"] = len(items) - cap
+            snap["serve.segments_total"] = len(items)
         # process-wide compile accounting (utils/compile_cache.py): the
         # serving replica's warm-start evidence — backend compiles,
         # persistent-cache hits/misses, and per-program trace counts
@@ -616,8 +709,12 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 self._send(400, {"error": f"bad deadline_ms or "
                                           f"timeout_s: {e}"})
                 return
+            segment = req.get("segment")
+            if segment is not None:
+                segment = str(segment)
             try:
-                fut = server.submit(arr, deadline_ms=deadline_ms)
+                fut = server.submit(arr, deadline_ms=deadline_ms,
+                                    segment=segment)
                 pred = fut.result(timeout=timeout_s)
             except BacklogFull as e:
                 self._send(429, {"error": str(e),
@@ -655,10 +752,13 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 self._send(code,
                            {"error": f"{type(e).__name__}: {e}"})
                 return
-            self._send(200, {
+            body = {
                 "predictions": np.asarray(pred).tolist(),
                 "num_rows": int(len(arr)),
-                "model_version": fut.info.get("model_version")})
+                "model_version": fut.info.get("model_version")}
+            if segment is not None:
+                body["segment"] = fut.info.get("segment", segment)
+            self._send(200, body)
 
         def _reload(self, req: dict) -> None:
             try:
@@ -692,11 +792,15 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
             self-check, shadow parity) — the incumbent keeps serving
             and the candidate never took a request."""
             from ..pipeline.continual import GateFailure
+            segment = req.get("segment")
+            if segment is not None:
+                segment = str(segment)
             try:
                 version, gate = server.promote(
                     snapshot=req.get("snapshot"),
                     model_file=req.get("model_file"),
-                    expected_sha256=req.get("sha256"))
+                    expected_sha256=req.get("sha256"),
+                    segment=segment)
             except ArtifactVerificationError as e:
                 self._send(409, {"error": str(e), "reason": str(e),
                                  "stage": "verify",
@@ -713,7 +817,10 @@ def start_http(server: Server, host: str = "127.0.0.1", port: int = 0,
                 self._send(400,
                            {"error": f"{type(e).__name__}: {e}"})
                 return
-            self._send(200, {"model_version": version, "gate": gate})
+            body = {"model_version": version, "gate": gate}
+            if segment is not None:
+                body["segment"] = segment
+            self._send(200, body)
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True

@@ -1,0 +1,387 @@
+"""Fleet subsystem: vmapped multi-forest training + segment serving.
+
+The contract under test (docs/Fleet.md):
+
+- ``fleet_train`` grows N same-shape boosters inside ONE vmapped
+  super-epoch program, and every member is BYTE-IDENTICAL to a solo
+  ``lgb.train`` run of that member's params (``fr.member_params[j]``)
+  — per-member RNG isolation across bagging, GOSS, sweeps, early
+  stopping and quantized training;
+- one stacked host fetch per fleet epoch (the solo one-sync-per-epoch
+  guarantee, N-wide);
+- kill + resume at an epoch boundary restores all N members
+  byte-identically from their per-member snapshots;
+- the serve-side ``SegmentRouter`` routes request ``segment`` keys
+  across co-resident registry versions byte-for-byte with the solo
+  predict of each routed model, falls back to the default segment for
+  unknown keys, and per-segment promotion never touches the registry's
+  current pointer;
+- metric label cardinality stays bounded (``serve_metrics_max_versions``
+  collapses overflow segments into ``__other__``) and the residency cap
+  (``serve_max_resident``) never evicts a version with requests in
+  flight.
+"""
+
+import glob
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import (FleetResult, SegmentRouter, expand_members,
+                                fleet_train, parse_sweep)
+
+BASE = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+        "max_bin": 31, "min_data_in_leaf": 5, "verbosity": -1,
+        "deterministic": True, "superepoch": 8, "fused_eval": True,
+        "fused_chunk": 8, "metric": ["binary_logloss"],
+        "padded_leaves": True, "split_batch": 1, "tpu_learner": "masked"}
+
+
+def _data(n=1200, f=10, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] + 0.4 * x[:, 2] * x[:, 3]
+         + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return x, y
+
+
+def _sets(x, y, params, n_train=1000):
+    ds = lgb.Dataset(x[:n_train], label=y[:n_train], params=params)
+    va = lgb.Dataset(x[n_train:], label=y[n_train:], params=params,
+                     reference=ds)
+    return ds, va
+
+
+# ---------------------------------------------------------------------------
+# roster expansion
+
+
+def test_parse_sweep_grid():
+    grid = parse_sweep("learning_rate=0.05|0.1;num_leaves=31|63")
+    assert len(grid) == 4
+    assert {(g["learning_rate"], g["num_leaves"]) for g in grid} == \
+        {(0.05, 31), (0.05, 63), (0.1, 31), (0.1, 63)}
+    # aliases resolve to the canonical member-axis name
+    assert parse_sweep("eta=0.2") == [{"learning_rate": 0.2}]
+    assert parse_sweep("") == []
+
+
+def test_parse_sweep_rejects_non_member_axis():
+    with pytest.raises(ValueError, match="member-axis"):
+        parse_sweep("max_bin=31|63")
+    with pytest.raises(ValueError, match="unknown parameter"):
+        parse_sweep("not_a_param=1|2")
+    with pytest.raises(ValueError, match="malformed"):
+        parse_sweep("learning_rate")
+
+
+def test_expand_members_precedence_and_paths():
+    p = dict(BASE, output_model="m.txt", fleet_members=3,
+             fleet_sweep="learning_rate=0.05|0.1")
+    # explicit members= wins over the sweep, the sweep over replicas
+    mm = expand_members(p, members=[{"seed": 1}, {"seed": 2}])
+    assert len(mm) == 2 and mm[1]["seed"] == 2
+    mm = expand_members(p)
+    assert len(mm) == 2 and mm[0]["learning_rate"] == 0.05
+    mm = expand_members(dict(p, fleet_sweep=""))
+    assert len(mm) == 3 and mm[2]["seed"] == 2    # cfg.seed=0 + j
+    # per-member snapshot/model paths never collide
+    assert [m["output_model"] for m in mm] == \
+        ["m.txt.member0", "m.txt.member1", "m.txt.member2"]
+    with pytest.raises(ValueError, match="member-axis"):
+        expand_members(p, members=[{"max_depth": 3}])
+
+
+# ---------------------------------------------------------------------------
+# byte-identity vs solo training (per-member RNG isolation)
+
+
+def _solo(member_params, x, y, rounds):
+    ds, va = _sets(x, y, member_params)
+    return lgb.train(dict(member_params), ds, num_boost_round=rounds,
+                     valid_sets=[va])
+
+
+def _assert_fleet_matches_solo(params, members=None, rounds=16):
+    x, y = _data()
+    ds, va = _sets(x, y, params)
+    fr = fleet_train(dict(params), ds, num_boost_round=rounds,
+                     valid_sets=[va], members=members)
+    assert isinstance(fr, FleetResult) and len(fr) >= 2
+    assert fr.epochs >= 1, "the vmapped epoch path must engage"
+    for j in range(len(fr)):
+        sb = _solo(fr.member_params[j], x, y, rounds)
+        assert fr[j].model_to_string() == sb.model_to_string(), \
+            f"member {j} diverged from its solo run"
+        assert fr[j].best_iteration == sb.best_iteration
+    return fr
+
+
+MATRIX = {
+    "bagging_replicas": (
+        {"bagging_fraction": 0.7, "bagging_freq": 1, "fleet_members": 2},
+        None),
+    "lr_leaves_sweep_es": (
+        {"fleet_sweep": "learning_rate=0.05|0.1;num_leaves=31|63",
+         "early_stopping_round": 5},
+        None),
+    "goss_grid": (
+        {"data_sample_strategy": "goss"},
+        [{"bagging_seed": 3}, {"bagging_seed": 11}]),
+    "quant_int8": (
+        {"quant_train": True, "quant_bits": 8, "fleet_members": 2},
+        None),
+}
+
+
+@pytest.mark.parametrize("name", list(MATRIX))
+def test_fleet_byte_identity(name):
+    extra, members = MATRIX[name]
+    _assert_fleet_matches_solo(dict(BASE, **extra), members=members)
+
+
+def test_fleet_early_stop_members_match_solo():
+    # aggressive lr + tight patience: members stop at DIFFERENT rounds
+    # (ragged early stop masks finished members inside the scan), and
+    # each still matches its solo run's best_iteration and forest
+    p = dict(BASE, fleet_members=2, early_stopping_round=3,
+             learning_rate=0.5, num_leaves=31)
+    fr = _assert_fleet_matches_solo(p, rounds=40)
+    assert any(fr.stopped), "expected at least one early-stopped member"
+
+
+# ---------------------------------------------------------------------------
+# one stacked fetch per fleet epoch
+
+
+def test_fleet_one_fetch_per_epoch():
+    from lightgbm_tpu.models.gbdt import GBDTModel
+    labels = []
+    orig = GBDTModel._eget
+
+    def spy(self, v, label=None):
+        labels.append(label)
+        return orig(self, v, label)
+
+    x, y = _data()
+    p = dict(BASE, fleet_members=2)
+    ds, va = _sets(x, y, p)
+    GBDTModel._eget = spy
+    try:
+        fr = fleet_train(dict(p), ds, num_boost_round=16,
+                         valid_sets=[va])
+    finally:
+        GBDTModel._eget = orig
+    # 16 rounds at k=8 -> 2 fleet epochs -> 2 stacked fetches carrying
+    # ALL members' telemetry; the solo fused fetch never fires
+    assert labels.count("fleet_fetch") == fr.epochs == 2
+    assert "fused_fetch" not in labels
+
+
+# ---------------------------------------------------------------------------
+# validation guards
+
+
+def test_fleet_requires_two_members():
+    x, y = _data(n=400)
+    ds = lgb.Dataset(x, label=y, params=BASE)
+    with pytest.raises(ValueError, match="member"):
+        fleet_train(dict(BASE), ds, num_boost_round=4,
+                    members=[{"seed": 1}])
+
+
+def test_fleet_rejects_shape_forking_sweep():
+    # 15 vs 31 leaves land in DIFFERENT leaf-pad buckets: the roster
+    # cannot share one program and must refuse, naming the contract
+    x, y = _data(n=400)
+    ds = lgb.Dataset(x, label=y, params=BASE)
+    with pytest.raises(ValueError, match="program shape|member"):
+        fleet_train(dict(BASE), ds, num_boost_round=4,
+                    members=[{"num_leaves": 15}, {"num_leaves": 31}])
+
+
+# ---------------------------------------------------------------------------
+# kill + resume at an epoch boundary
+
+
+def test_fleet_kill_resume_restores_all_members(tmp_path):
+    out = str(tmp_path / "m.txt")
+    p = dict(BASE, snapshot_freq=8, output_model=out, fleet_members=3,
+             bagging_fraction=0.7, bagging_freq=1)
+    x, y = _data()
+
+    def run(rounds, resume=False):
+        ds, va = _sets(x, y, p)
+        pp = dict(p, resume=True) if resume else dict(p)
+        return fleet_train(pp, ds, num_boost_round=rounds,
+                           valid_sets=[va])
+
+    straight = run(16)
+    texts = [b.model_to_string() for b in straight.boosters]
+    for f in glob.glob(out + "*"):
+        os.unlink(f)
+
+    run(8)                          # "crash" after one epoch (snapshot)
+    resumed = run(16, resume=True)
+    assert len(resumed) == 3
+    for j in range(3):
+        assert resumed[j].model_to_string() == texts[j], \
+            f"member {j} not restored byte-identically"
+
+
+# ---------------------------------------------------------------------------
+# segment-routed serving
+
+
+def _train_solo_model(x, y, seed):
+    p = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+         "min_data_in_leaf": 5, "verbosity": -1, "deterministic": True,
+         "seed": seed, "bagging_seed": 3 + seed}
+    return lgb.train(p, lgb.Dataset(x, label=y, params=p),
+                     num_boost_round=8)
+
+
+class TestSegmentRouting:
+    def test_router_resolution(self):
+        r = SegmentRouter("default")
+        assert r.resolve("eu") == (None, True)     # unknown, no default
+        r.assign("default", "v1")
+        r.assign("eu", "v2")
+        assert r.resolve("eu") == ("v2", False)
+        assert r.resolve("unknown") == ("v1", True)
+        assert r.resolve(None) == ("v1", False)    # unkeyed: no miss
+        assert r.fallbacks() == 2
+        assert r.unassign("eu") == "v2"
+        assert r.resolve("eu") == ("v1", True)
+        r.assign("us", "v9")
+        assert r.drop_version("v9") == ["us"]
+
+    def test_segment_parity_promote_and_fallback(self, tmp_path):
+        from lightgbm_tpu.serve.server import Server
+        x, y = _data(n=500)
+        paths, solos = [], []
+        for j in range(3):
+            b = _train_solo_model(x, y, j)
+            fp = str(tmp_path / f"m{j}.txt")
+            b.save_model(fp)
+            paths.append(fp)
+            solos.append(lgb.Booster(model_file=fp).predict(x[:16]))
+        srv = Server({"verbosity": -1, "shadow_probe_batches": 4,
+                      "serve_metrics_max_versions": 2},
+                     model_file=paths[0])
+        try:
+            for _ in range(3):      # feed the shadow-parity gate ring
+                srv.predict(x[:16])
+            v1, _ = srv.promote(model_file=paths[1], segment="eu")
+            v2, _ = srv.promote(model_file=paths[2], segment="us")
+            # per-segment promote never moves the default pointer
+            assert srv.registry.current().version not in (v1, v2)
+            # byte-for-byte parity with each routed model's solo predict
+            assert np.array_equal(srv.predict(x[:16], segment="eu"),
+                                  solos[1])
+            assert np.array_equal(srv.predict(x[:16], segment="us"),
+                                  solos[2])
+            # unknown key falls back to the default segment's serving
+            assert np.array_equal(srv.predict(x[:16], segment="nope"),
+                                  solos[0])
+            assert np.array_equal(srv.predict(x[:16]), solos[0])
+            assert srv.router.fallbacks() >= 1
+            snap = srv.metrics_snapshot()
+            assert snap["serve.segments"] == {"eu": v1, "us": v2}
+            # label cardinality bound: cap=2, the third distinct
+            # segment's row counter collapses into __other__
+            rows_keys = {k for k in snap
+                         if k.startswith("serve.segment_rows")}
+            assert "serve.segment_rows{segment=__other__}" in rows_keys
+            assert len(rows_keys) <= 3
+            # rollback: unassigning routes the segment back to default
+            srv.router.unassign("eu")
+            assert np.array_equal(srv.predict(x[:16], segment="eu"),
+                                  solos[0])
+        finally:
+            srv.close()
+
+    def test_batcher_never_mixes_segments(self):
+        from lightgbm_tpu.serve.batcher import MicroBatcher
+        seen = []
+        lock = threading.Lock()
+
+        def predict(rows, key=None):
+            with lock:
+                seen.append((len(rows), key))
+            return np.zeros(len(rows)), {"key": key}
+
+        mb = MicroBatcher(predict, max_batch=64, max_wait_ms=20.0)
+        try:
+            futs = [mb.submit(np.zeros((1, 4)),
+                              key=("a", "b", None)[i % 3])
+                    for i in range(30)]
+            for f in futs:
+                f.result(timeout=10)
+        finally:
+            mb.close()
+        # coalescing stops at a key boundary: every dispatched batch
+        # carries exactly one segment key (version isolation per batch)
+        assert sum(n for n, _ in seen) == 30
+        assert {k for _, k in seen} == {"a", "b", None}
+
+
+# ---------------------------------------------------------------------------
+# residency-cap eviction with in-flight pinning (stress)
+
+
+@pytest.mark.stress
+def test_eviction_never_drops_inflight_version(tmp_path):
+    # ~100 co-resident versions churn through a small residency cap
+    # while requests are IN FLIGHT on pinned versions: the cap must
+    # displace idle versions only — no in-flight request ever loses the
+    # model it resolved (registry skips versions with inflight > 0)
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    x, y = _data(n=300)
+    bst = _train_solo_model(x, y, 0)
+    ms = bst.model_to_string()
+    reg = ModelRegistry(max_batch=32, max_resident=8)
+    v0 = reg.load(model_str=ms)
+    pinned = [reg.get(v0)]
+    errors = []
+    stop = threading.Event()
+
+    def pinner(served):
+        # hold requests open on a pinned version while the churn runs
+        try:
+            while not stop.is_set():
+                served.begin_request()
+                try:
+                    served.booster.predict(x[:4])
+                finally:
+                    served.end_request()
+        except Exception as e:      # noqa: BLE001
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=pinner, args=(pinned[0],))
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        versions = [v0]
+        for i in range(100):
+            versions.append(reg.load(model_str=ms, activate=False))
+            if i == 50:             # pin a mid-churn version too
+                served = reg.get(versions[-1])
+                pinned.append(served)
+                t = threading.Thread(target=pinner, args=(served,))
+                t.start()
+                threads.append(t)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    # the cap held (pinned versions may exceed it transiently)…
+    assert len(reg.versions()) <= 8 + len(pinned)
+    # …and every pinned version is still resident and lookupable
+    for served in pinned:
+        assert reg.get(served.version) is served

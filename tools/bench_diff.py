@@ -74,6 +74,11 @@ _GATEABLE = re.compile(
     # per-k sweep keys
     r"|^superepoch_(iters_per_s|sync_count_per_iter"
     r"|k\d+_(valid|novalid)_(iters_per_s|syncs_per_iter))$"
+    # fleet sweep (ISSUE 19, tools/bench_fleet.run_bench): the N=8
+    # vmapped aggregate + the speedup ratio vs sequential solos, plus
+    # the per-width sweep keys
+    r"|^fleet_(agg_iters_per_s|speedup_x8"
+    r"|n\d+_(agg_iters_per_s|speedup)|solo\d+_agg_iters_per_s)$"
     r"|^continual_(freshness_lag_s|gen_s)$"
     # out-of-core ingest (ISSUE 17, lightgbm_tpu/ingest.py): streaming
     # throughput, the bounded-memory subprocess RSS, and the

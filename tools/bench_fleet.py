@@ -1,0 +1,142 @@
+"""Fleet training bench: vmapped multi-forest vs sequential solo runs.
+
+Run: python tools/bench_fleet.py [n_rows] [rounds] [sizes]
+
+  sizes   comma list of fleet widths, default ``1,4,8,16``
+
+For each N the sweep times ONE warm ``fleet_train`` run of N members
+(a feature_fraction-seed roster — every member is a distinct forest
+but all share one super-epoch program shape) against N warm sequential
+solo ``lgb.train`` runs of the same member configs, and reports the
+AGGREGATE iters/s of each side (``N * rounds / seconds``).  A warmup
+run of the same shape precedes every timed run so compile cost is
+excluded: the fleet's claim is steady-state sweep throughput — the
+vmapped program amortizes the per-epoch host round-trip (one ``_eget``
+for all N members) and batches N small member programs into one, which
+is where small-data hyperparameter sweeps spend their time.  Solo runs
+share one compiled program across members (per-member seeds are scan
+operands, not trace constants), so the baseline is also warm after one
+member — the comparison is dispatch-for-dispatch fair.
+
+``run_bench()`` is importable: bench.py folds the returned dict into
+its extras as ``fleet_<key>`` (tools/perf_budget.txt pins the headline
+``fleet_agg_iters_per_s`` — the N=8 vmapped aggregate — and the
+``fleet_speedup_x8`` ratio against 8 sequential solos).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def _make_data(n, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float32)
+    logit = (1.1 * x[:, 0] - 0.7 * x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+             + 0.4 * rng.randn(n))
+    y = (logit > 0).astype(np.float32)
+    return x, y
+
+
+def _base_params(num_leaves=15):
+    return {"objective": "binary", "num_leaves": num_leaves,
+            "learning_rate": 0.1, "min_data_in_leaf": 5,
+            "verbosity": -1, "deterministic": True,
+            "tpu_learner": "masked", "superepoch": 8,
+            "fused_eval": True, "fused_chunk": 8,
+            "metric": ["binary_logloss"], "padded_leaves": True,
+            "split_batch": 1, "feature_fraction": 0.9}
+
+
+def _members(n):
+    # distinct forests, one shared program shape: only the per-member
+    # RNG stream differs, and seeds ride the scan as operands
+    return [{"feature_fraction_seed": 100 + j} for j in range(n)]
+
+
+def _mk_dataset(lgb, x, y, params):
+    ds = lgb.Dataset(x, label=y, params=dict(params))
+    ds.construct()
+    return ds
+
+
+def run_bench(n_rows=500, rounds=32, sizes=(1, 4, 8, 16), n_feat=10,
+              num_leaves=15, log=None):
+    """{key: value} over fleet widths; see module docstring."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.fleet import fleet_train
+
+    x, y = _make_data(n_rows, n_feat)
+    base = _base_params(num_leaves)
+    out = {"n_rows": n_rows, "rounds": rounds}
+
+    # solo baseline: ONE warmup train compiles the shared program, then
+    # each width's baseline is the sum of N warm sequential runs
+    def solo(mj):
+        p = dict(base)
+        p.update(mj)
+        return lgb.train(p, _mk_dataset(lgb, x, y, base),
+                         num_boost_round=rounds)
+
+    solo(_members(1)[0])                                 # warm/compile
+    solo_dt = {}
+    for n in sorted(sizes):
+        t0 = time.perf_counter()
+        for mj in _members(n):
+            bst = solo(mj)
+        solo_dt[n] = time.perf_counter() - t0
+        assert len(bst.trees) == rounds
+        out[f"solo{n}_agg_iters_per_s"] = round(
+            n * rounds / solo_dt[n], 3)
+
+    for n in sorted(sizes):
+        if n < 2:
+            # fleet_train requires >= 2 members; N=1 IS the solo run
+            out["n1_agg_iters_per_s"] = out.get("solo1_agg_iters_per_s")
+            continue
+        mem = _members(n)
+        try:
+            fleet_train(dict(base), _mk_dataset(lgb, x, y, base),
+                        num_boost_round=rounds, members=mem)  # warm
+            t0 = time.perf_counter()
+            fr = fleet_train(dict(base), _mk_dataset(lgb, x, y, base),
+                             num_boost_round=rounds, members=mem)
+            dt = time.perf_counter() - t0
+        except Exception as e:                          # noqa: BLE001
+            out[f"n{n}_error"] = f"{type(e).__name__}: {e}"[:120]
+            continue
+        assert all(len(b.trees) == rounds for b in fr.boosters)
+        agg = n * rounds / dt
+        out[f"n{n}_agg_iters_per_s"] = round(agg, 3)
+        out[f"n{n}_speedup"] = round(agg * solo_dt[n] / (n * rounds), 3)
+        if log:
+            log(f"N={n}: fleet {dt:.2f}s ({agg:.2f} agg iters/s), "
+                f"solo {solo_dt[n]:.2f}s -> {out[f'n{n}_speedup']:.2f}x")
+
+    # headline keys (tools/perf_budget.txt pins): the acceptance shape
+    # is N=8 vmapped vs 8 sequential solos, both warm
+    if "n8_agg_iters_per_s" in out:
+        out["agg_iters_per_s"] = out["n8_agg_iters_per_s"]
+        out["speedup_x8"] = out["n8_speedup"]
+    return out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    sizes = tuple(int(s) for s in sys.argv[3].split(",")) \
+        if len(sys.argv) > 3 else (1, 4, 8, 16)
+
+    import jax
+    print(f"devices={jax.devices()}", file=sys.stderr, flush=True)
+    res = run_bench(n, rounds, sizes,
+                    log=lambda m: print(m, file=sys.stderr, flush=True))
+    import json
+    print(json.dumps(res, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

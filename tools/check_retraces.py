@@ -166,6 +166,26 @@ def run_matrix() -> Dict[str, int]:
                    valid=[(x[:200], y[:200])],
                    metric=["binary_logloss"])
 
+    # 4c. fleet training (ISSUE 19): an N=8 member roster mixing
+    #    num_leaves 31/63 and a learning-rate grid trains through ONE
+    #    vmapped super-epoch scan trace — the leaf budget pads every
+    #    member onto L=64, per-member lr/seeds ride as batched operands,
+    #    and `fleet_superepoch_fn` keys the program on bucketed shapes
+    #    only, so the whole fleet compiles once, not once per member
+    with _Scope("fleet", measured):
+        from lightgbm_tpu.fleet import fleet_train
+        fp = _base_params(num_leaves=31, superepoch=8, fused_chunk=8,
+                          split_batch=1, metric=["binary_logloss"],
+                          fused_eval=True, padded_leaves=True,
+                          deterministic=True, verbosity=-1)
+        mem = [{"num_leaves": 31 if j % 2 == 0 else 63,
+                "learning_rate": 0.05 + 0.02 * j} for j in range(8)]
+        ds = lgb.Dataset(x, label=y, params=fp)
+        va = lgb.Dataset(x[:200], label=y[:200], params=fp,
+                         reference=ds)
+        fleet_train(fp, ds, num_boost_round=8, valid_sets=[va],
+                    members=mem)
+
     # 5. serve batch mix: pow2-bucketed engine bounds forest traces
     with _Scope("serve_buckets", measured):
         from lightgbm_tpu.serve.engine import PredictorEngine
@@ -203,6 +223,25 @@ def run_matrix() -> Dict[str, int]:
         assert e2 is not None and e2.fused_reason is None
         for n in (3, 5, 17, 30, 64, 100):
             e2.fused_predict(x[:n])
+
+    # 7b. fleet serving (ISSUE 19): a segment-routed request mix across
+    #    the co-resident versions — per-segment assignments, an unknown
+    #    key falling back to default, pow2 batch sizes — must serve
+    #    with ZERO forest traces: routing only picks WHICH cached
+    #    engine runs, and same-family versions share every serve trace
+    #    (scenario 7).  check() enforces zero like serve_cohost; the
+    #    budget file carries no fleet_serve pins by construction
+    with _Scope("fleet_serve", measured):
+        from lightgbm_tpu.fleet import SegmentRouter
+        router = SegmentRouter()
+        router.assign(router.default_segment, v1)
+        router.assign("eu", v2)
+        router.assign("us", v1)
+        for seg in ("eu", "us", "unknown-key", None):
+            ver, _fb = router.resolve(seg)
+            eng = reg.get(ver).engine
+            for n in (3, 17, 64, 100):
+                eng.fused_predict(x[:n])
 
     # 8. distributed leaf sweep (ROADMAP item-1 remainder): the padded
     #    leaf budget + the process-level shard_map memo in the voting
@@ -288,6 +327,11 @@ def check(measured: Dict[str, int],
                 f"co-hosted model re-traced: {k} = {measured[k]} "
                 "(second version of one model family must share every "
                 "serve trace via the pow2 SoA padding)")
+        elif k.startswith("fleet_serve."):
+            findings.append(
+                f"segment-routed serving re-traced: {k} = {measured[k]} "
+                "(the fleet router only selects which cached engine "
+                "serves — a segment mix must not compile anything)")
     # the negative control must PROVE the lint catches unbucketed
     # regressions: the same sweep without bucketing has to exceed the
     # bucketed grower budget
