@@ -643,6 +643,52 @@ def child_extras() -> None:
     except Exception as e:
         _record_point("ingest", error=f"{type(e).__name__}: {e}"[:200])
 
+    # integrity-layer overhead (ISSUE 20, lightgbm_tpu/integrity.py):
+    # checked (integrity_check_freq=16: shadow re-execution every 16th
+    # iteration + traced invariants riding the consolidated fetch) vs
+    # unchecked iters/s on the per-iteration masked path, same binned
+    # data.  Folds into extras as integrity_overhead_pct — pinned
+    # lower-better in tools/perf_budget.txt: the "pay only on check
+    # iterations" contract, measured
+    try:
+        # CPU fallback shrinks harder than the other points: the
+        # masked one-program grower this measures runs ~8 s/iter at
+        # the 20k/255-bin shape on a CPU host, and the point needs
+        # ~80 iterations (warm-up past the first shadow compile +
+        # 32 timed at each freq)
+        n_g = 5_000 if cpu else 200_000
+        xg, yg = make_higgs_like(n_g, N_FEAT, seed=5)
+        pg = {"objective": "binary", "num_leaves": 31,
+              "max_bin": 63 if cpu else PRIMARY_MAX_BIN,
+              "min_data_in_leaf": 20,
+              "verbosity": -1, "tpu_learner": "masked"}
+        dsg = lgb.Dataset(xg, label=yg, params=pg)
+        dsg.construct()
+
+        def _ips_at(freq):
+            bst = lgb.Booster(params=dict(pg, integrity_check_freq=freq),
+                              train_set=dsg)
+            m = bst._model
+            for _ in range(max(freq, 1) + 1):   # warm: compile primary
+                bst.update()                    # AND the shadow's first
+            np.asarray(m.score)                 # check iteration
+            t0 = time.time()
+            n0 = m.iter_
+            for _ in range(32):
+                bst.update()
+            np.asarray(m.score)
+            return (m.iter_ - n0) / max(time.time() - t0, 1e-9)
+
+        ips_off = _ips_at(0)
+        ips_on = _ips_at(16)
+        overhead = max(0.0, (ips_off / max(ips_on, 1e-9) - 1.0) * 100.0)
+        _record_point("integrity", cpu=cpu, check_freq=16,
+                      unchecked_ips=round(ips_off, 3),
+                      checked_ips=round(ips_on, 3),
+                      overhead_pct=round(overhead, 1))
+    except Exception as e:
+        _record_point("integrity", error=f"{type(e).__name__}: {e}"[:200])
+
     # comm wire bytes per boosting iteration (obs/comm.py static model,
     # same math the telemetry counters use at train time): the in-flight
     # number arXiv:1706.08359 instruments to validate scaling — one

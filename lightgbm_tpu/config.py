@@ -382,6 +382,28 @@ _PARAMS: Dict[str, tuple] = {
     # iteration contributes a zero stump) | clamp (nan_to_num gradients
     # and leaf outputs, applied every iteration — it is sync-free)
     "finite_check_policy": (str, "raise", []),
+    # ---- computation integrity (lightgbm_tpu/integrity.py) ----
+    # silent-data-corruption detection: every k iterations re-execute
+    # the iteration's grow (histogram contraction + split scan) through
+    # an independently-jitted shadow program and compare — bitwise on
+    # int32 fields, ulp-bounded on f32 — plus cheap in-graph invariants
+    # riding the existing consolidated fetch every iteration.  0
+    # disables the layer entirely (byte-identical to pre-integrity
+    # behavior, zero extra host syncs).  Forces the per-iteration
+    # training path (fused_chunk/super-epoch fall back; see
+    # GBDTModel.fused_reasons)
+    "integrity_check_freq": (int, 0, []),
+    # what a STICKY mismatch (fails the one re-check) does: raise
+    # (IntegrityFailure, kind "sdc") | rewind (engine.train re-enters
+    # from the newest integrity-verified snapshot, up to
+    # integrity.MAX_REWINDS times) | quarantine (additionally marks the
+    # suspect devices so the elastic ladder's next mesh excludes them)
+    "integrity_policy": (str, "raise", []),
+    # float32 comparison slack for the shadow compare, in ulps (units
+    # in the last place); int32 fields are always compared bitwise.
+    # 0 = exact; the default absorbs benign reassociation between the
+    # two traces
+    "integrity_ulp_tol": (int, 2, []),
     # newest snapshots kept on disk (model + manifest + state pruned
     # together); <= 0 keeps all
     "snapshot_keep": (int, 3, []),
@@ -801,6 +823,14 @@ class Config:
             raise ValueError(
                 f"finite_check_policy={self.finite_check_policy!r} must be "
                 "one of: raise, skip_iter, clamp")
+        if self.integrity_check_freq < 0:
+            raise ValueError("integrity_check_freq must be >= 0")
+        if self.integrity_policy not in ("raise", "rewind", "quarantine"):
+            raise ValueError(
+                f"integrity_policy={self.integrity_policy!r} must be "
+                "one of: raise, rewind, quarantine")
+        if self.integrity_ulp_tol < 0:
+            raise ValueError("integrity_ulp_tol must be >= 0")
         if self.compile_cache_min_compile_s < 0:
             raise ValueError("compile_cache_min_compile_s must be >= 0")
         if self.compile_cache_min_entry_bytes < 0:

@@ -42,7 +42,53 @@ def train(params: Dict[str, Any], train_set: Dataset,
     snapshot of ``output_model`` (manifest params-signature + data
     fingerprint must match, snapshot.py) through this function's
     init_model path; train-straight and crash-then-resume produce
-    byte-identical model text (docs/Fault-Tolerance.md)."""
+    byte-identical model text (docs/Fault-Tolerance.md).
+
+    Under ``integrity_policy=rewind`` (lightgbm_tpu/integrity.py) a
+    sticky silent-data-corruption failure rewinds here: training
+    re-enters with ``resume=true``, which lands on the newest
+    integrity-VERIFIED snapshot (``find_latest_snapshot`` prefers the
+    stamp) and replays byte-identically — up to
+    ``integrity.MAX_REWINDS`` times before the failure propagates."""
+    from .integrity import MAX_REWINDS, IntegrityFailure
+    rewinds = 0
+    while True:
+        try:
+            return _train_impl(params, train_set, num_boost_round,
+                               valid_sets, valid_names, fobj, feval,
+                               init_model, feature_name,
+                               categorical_feature,
+                               keep_training_booster, callbacks)
+        except IntegrityFailure as sdc:
+            from .config import canonical_params
+            cp = canonical_params(dict(params or {}))
+            policy = str(cp.get("integrity_policy", "raise"))
+            if policy != "rewind" or init_model is not None \
+                    or rewinds >= MAX_REWINDS:
+                # raise/quarantine surface the classified failure (the
+                # elastic ladder catches kind "sdc" and re-enters with
+                # a quarantined mesh); an explicit init_model run has
+                # no self-owned snapshot history to rewind into
+                raise
+            rewinds += 1
+            from .integrity import _metrics as _int_metrics
+            _int_metrics().counter("integrity.rewinds").inc()
+            from .utils.log import Log
+            Log.warning(
+                f"integrity: sticky SDC at iteration {sdc.iteration}; "
+                "rewinding to the newest integrity-verified snapshot "
+                f"(attempt {rewinds}/{MAX_REWINDS})")
+            params = dict(params or {})
+            params["resume"] = True
+
+
+def _train_impl(params: Dict[str, Any], train_set: Dataset,
+                num_boost_round: int,
+                valid_sets, valid_names, fobj, feval, init_model,
+                feature_name, categorical_feature,
+                keep_training_booster, callbacks) -> Booster:
+    """One training attempt (the body of :func:`train`; the wrapper
+    owns only the integrity-rewind re-entry loop)."""
     params = dict(params or {})
     # resume is a run-control switch, not a model hyperparameter: strip
     # it (and its aliases) from the params that reach the Booster so the
@@ -320,6 +366,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
             Log.info(f"{_time.time() - t_start:.6f} seconds elapsed, "
                      f"finished iteration {i + 1}")
         if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
+            # integrity boundary check FIRST, and OUTSIDE the write's
+            # skip-and-warn: the manifest's integrity stamp must mean
+            # 'verified AT this snapshot', and a sticky boundary
+            # mismatch must fail the run (IntegrityFailure), never be
+            # swallowed as a failed write
+            ib = getattr(getattr(booster, "_model", None),
+                         "integrity_boundary_check", None)
+            if ib is not None:
+                ib()
             # periodic crash-safe snapshot: model + f32 score state +
             # manifest, each written atomically; prunes to snapshot_keep
             # (gbdt.cpp:279-284 snapshot_freq + snapshot.py)
@@ -401,6 +456,8 @@ def _superepoch_plan(cfg, booster, fobj, feval, cbs_before, cbs_after,
         return None
     if not model._fusable_config() or model._faults_active():
         return None
+    if getattr(model, "_integrity", None) is not None:
+        return None       # integrity layer: per-iteration path only
     import jax
     if str(cfg.fused_eval).lower() == "false" and model.valid_sets:
         return None

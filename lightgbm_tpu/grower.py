@@ -1279,3 +1279,16 @@ def make_grower(*, num_leaves: int, num_bins: int, params: SplitParams,
         else:
             _SHARED_GROWERS.move_to_end(key)
     return shared
+
+
+def make_shadow_grower(**kwargs):
+    """An INDEPENDENTLY-jitted twin of ``make_grower(**kwargs)`` for the
+    computation-integrity layer (lightgbm_tpu/integrity.py): same
+    logical math, but a separate ``jax.jit`` wrapper that deliberately
+    bypasses the ``_SHARED_GROWERS`` memo — so the shadow program is a
+    second trace AND a second compiled executable, and a silently wrong
+    answer must reproduce across two distinct programs to evade the
+    compare.  The extra trace is intentional and accounted in
+    tools/retrace_budget (sites fire only when integrity_check_freq>0).
+    """
+    return jax.jit(make_grower(**dict(kwargs, jit=False)))

@@ -1,0 +1,443 @@
+"""Computation integrity: silent-data-corruption (SDC) detection,
+last-good rewind, and suspect-device quarantine.
+
+Every other robustness layer (elastic recovery, survivable ingest,
+hardened serving) defends against failures that announce themselves —
+hangs, crashes, torn files.  This layer defends against the marginal
+chip that keeps running but computes wrong NUMBERS: one flipped bit in
+a histogram silently diverges the forest and poisons every downstream
+snapshot, fleet member, and served prediction.  Two existing contracts
+make that detectable and recoverable:
+
+- the quantized int32 histogram/reduce path is bitwise deterministic
+  (dp == serial, docs/Determinism.md), so a redundant recompute is an
+  EXACT oracle on the quant path and an ulp-bounded one on f32;
+- snapshots are byte-identical kill+resume points, so the newest
+  integrity-verified snapshot is a sound rewind target.
+
+Mechanics (wired in ``models/gbdt.GBDTModel.train_one_iter`` and
+``engine.train``; docs/Fault-Tolerance.md layer 7):
+
+**Detection.**  Every ``integrity_check_freq`` iterations (and at every
+snapshot boundary) the iteration's grow — histogram contraction + split
+scan — is re-executed through an INDEPENDENTLY-jitted shadow program:
+``jax.jit`` over the unjitted grower builds a second trace of the same
+logical math, so a wrong answer must reproduce across two distinct
+compiled programs to evade the compare (bitwise on int32/bool fields,
+``integrity_ulp_tol``-bounded on f32).  Additionally, cheap in-graph
+invariants ride the existing consolidated ``_eget`` fetch EVERY
+iteration as one traced boolean — parent/child count conservation down
+the tree (the subtraction trick makes it exact), leaf-total == root
+count, split-gain finiteness — so steady state gains ZERO extra host
+syncs.  The row->leaf partition itself stays on device (fetching [N]
+ints would defeat the consolidated-fetch design); corruption there
+surfaces through the score-path check (``verify_score``) instead.
+
+**Transient vs sticky.**  A mismatch is re-run ONCE (fresh primary +
+fresh shadow).  A clean re-run is a transient — absorbed: the re-run's
+arrays become the iteration's result, so the final model is
+byte-identical to an uninjected run.  A second mismatch is sticky:
+blackbox-dump the divergent fields, attribute suspect devices, record
+an ``elastic.*`` failure event, and raise :class:`IntegrityFailure`
+(``ElasticFailure`` kind ``"sdc"``).
+
+**Recovery.**  Policy ``rewind``: ``engine.train`` catches the failure
+and re-enters itself with ``resume=True`` — snapshot manifests carry an
+``integrity`` stamp, and ``snapshot.find_latest_snapshot`` prefers the
+newest VERIFIED snapshot over a newer unverified one — up to
+:data:`MAX_REWINDS` times.  Policy ``quarantine``: additionally mark
+the suspect devices (``parallel/elastic.mark_suspect``) so the elastic
+ladder's next rung runs mesh-minus-suspects instead of halving, and
+``GBDTModel._resolve_mesh`` excludes them from the claim.
+
+Fault injection: sites ``hist_sdc`` / ``score_sdc`` with the
+``bitflip`` action (``utils/faultinject.maybe_bitflip``) are the chaos
+substrate; ``tools/soak_train.py --chaos sdc`` drives the full
+transient + sticky + rewind + quarantine ladder in one run.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .obs.metrics import MetricsRegistry
+from .parallel.elastic import ElasticFailure, _on_failure, mark_suspect
+from .utils.log import Log
+
+# sticky-SDC rewind budget per training entry: past this, engine.train
+# stops re-entering and re-raises (a chip that corrupts three rewinds
+# in a row is not transient — quarantine or die loudly)
+MAX_REWINDS = 3
+
+# integrity.* metrics: host-side counter bumps on check/mismatch paths
+# only — nothing per-iteration in steady state (same always-on contract
+# as the elastic.* registry)
+_REGISTRY = MetricsRegistry()
+_REGISTRY_LOCK = threading.Lock()
+
+
+def metrics_snapshot() -> dict:
+    """Deterministic dict snapshot of the ``integrity.*`` metrics."""
+    return _REGISTRY.snapshot()
+
+
+def reset_metrics() -> None:
+    """Test hook: drop all ``integrity.*`` metric state."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        _REGISTRY = MetricsRegistry()
+
+
+def _metrics() -> MetricsRegistry:
+    with _REGISTRY_LOCK:
+        return _REGISTRY
+
+
+class IntegrityFailure(ElasticFailure):
+    """A STICKY computation-integrity mismatch (survived the one
+    re-check), classified as ``ElasticFailure`` kind ``"sdc"`` so the
+    recovery ladder and ``failure_kind`` treat it like any other
+    classified failure.  Carries the 1-based iteration it fired on,
+    the attributed suspect device ids, and the divergent-field summary
+    (also blackbox-dumped)."""
+
+    def __init__(self, detail: str = "", iteration: Optional[int] = None,
+                 devices: Tuple[int, ...] = (),
+                 divergences: Tuple[Dict[str, Any], ...] = ()):
+        self.iteration = iteration
+        self.devices = tuple(devices)
+        self.divergences = tuple(divergences)
+        super().__init__("sdc", detail)
+
+
+# ---------------------------------------------------------------------------
+# Comparison primitives (host-side numpy; operands come off the one fetch)
+# ---------------------------------------------------------------------------
+
+def _float_ord(x: np.ndarray) -> np.ndarray:
+    """Monotone-within-sign int64 key of f32 bit patterns: the distance
+    between two same-sign keys is their ulp distance.  Cross-sign pairs
+    map far apart, which is the right answer for a compare (a sign flip
+    IS a divergence; numerically-equal ±0.0 is short-circuited by the
+    equality check before this runs)."""
+    i = np.ascontiguousarray(x, np.float32).view(np.int32).astype(np.int64)
+    return np.where(i >= 0, i, (np.int64(1) << 31) - 1 - i)
+
+
+def ulp_delta(a, b) -> np.ndarray:
+    """Elementwise ulp distance between two f32 arrays (0 where equal,
+    including NaN==NaN and -0.0==+0.0)."""
+    av = np.asarray(a, np.float32)
+    bv = np.asarray(b, np.float32)
+    same = (av == bv) | (np.isnan(av) & np.isnan(bv))
+    d = np.abs(_float_ord(av) - _float_ord(bv))
+    return np.where(same, 0, d)
+
+
+def compare_tree_arrays(a, b, ulp_tol: int = 0) -> List[Dict[str, Any]]:
+    """Field-by-field compare of two host ``TreeArrays``: bitwise on
+    int/bool fields, ``ulp_tol``-bounded on floats.  Returns one record
+    per divergent field — ``{"field", "count", "index", "got",
+    "want", "ulp"}`` with the first divergent element as the sample —
+    empty list == match.  ``leaf_of_row`` is skipped: the consolidated
+    fetch replaces it with a scalar placeholder (and the [N] partition
+    deliberately never leaves the device)."""
+    out: List[Dict[str, Any]] = []
+    for name, av, bv in zip(type(a)._fields, a, b):
+        if name == "leaf_of_row":
+            continue
+        av = np.asarray(av)
+        bv = np.asarray(bv)
+        if av.shape != bv.shape:
+            out.append({"field": name, "count": -1,
+                        "got": list(av.shape), "want": list(bv.shape),
+                        "index": -1, "ulp": -1})
+            continue
+        if np.issubdtype(av.dtype, np.floating):
+            d = ulp_delta(av, bv)
+            bad = d > ulp_tol
+        else:
+            bad = np.asarray(av != bv)
+            d = bad.astype(np.int64)
+        if not bad.any():
+            continue
+        idx = int(np.argmax(bad.ravel()))
+        out.append({
+            "field": name,
+            "count": int(bad.sum()),
+            "index": idx,
+            "got": float(np.ravel(av)[idx]) if av.ndim else float(av),
+            "want": float(np.ravel(bv)[idx]) if bv.ndim else float(bv),
+            "ulp": int(np.ravel(d)[idx]),
+        })
+    return out
+
+
+def invariant_flags(arrays):
+    """ONE traced boolean: cheap in-graph invariants of a freshly grown
+    tree, evaluated on device and fetched as part of the existing
+    consolidated ``_eget`` — zero extra host syncs.
+
+    - **count conservation** (subtraction trick): every live internal
+      node's count equals the sum of its children's counts;
+    - **total conservation**: the live leaf counts sum to the root's
+      count (for an unweighted, unbagged run the root count is the row
+      count; under bagging/GOSS it is the weight total, which the same
+      identity still pins);
+    - **gain finiteness** over live internal nodes.
+
+    Counts are f32 weight sums, so conservation uses a relative slack
+    of 1e-3 (+0.5 absolute) — loose enough never to false-positive on
+    rounding, tight enough that any injected bit flip above the bottom
+    few mantissa bits trips it.
+    """
+    import jax.numpy as jnp
+    lc = arrays.leaf_count
+    ic = arrays.internal_count
+    nl = arrays.num_leaves
+    L = lc.shape[0]
+    nnode = ic.shape[0]
+    leaf_live = jnp.arange(L, dtype=jnp.int32) < nl
+    node_live = jnp.arange(nnode, dtype=jnp.int32) < (nl - 1)
+
+    def _child_count(c):
+        is_leaf = c < 0
+        li = jnp.where(is_leaf, ~c, 0)
+        ni = jnp.where(is_leaf, 0, c)
+        return jnp.where(is_leaf, jnp.take(lc, li, mode="clip"),
+                         jnp.take(ic, ni, mode="clip"))
+
+    kid = _child_count(arrays.left_child) + _child_count(arrays.right_child)
+    slack = 0.5 + 1e-3 * jnp.abs(ic)
+    conserve_ok = jnp.where(node_live,
+                            jnp.abs(ic - kid) <= slack, True).all()
+    tot = jnp.sum(jnp.where(leaf_live, lc, 0.0))
+    root = jnp.where(nl > 1, ic[0], lc[0])
+    total_ok = jnp.abs(tot - root) <= (0.5 + 1e-3 * jnp.abs(root))
+    gain_ok = jnp.isfinite(
+        jnp.where(node_live, arrays.split_gain, 0.0)).all()
+    return conserve_ok & total_ok & gain_ok
+
+
+def attribute_devices(x) -> List[int]:
+    """Coarse suspect attribution from a divergent array's placement.
+    A single-device (serial-rung) result names that chip exactly; a
+    replicated/sharded result cannot localize WHICH participant flipped
+    the bit, so the highest device id is picked deterministically — a
+    documented heuristic that keeps quarantine monotone (repeat sticky
+    failures walk the mesh down one suspect at a time) rather than
+    precise."""
+    try:
+        ids = sorted(int(d.id) for d in x.devices())
+    except Exception:   # noqa: BLE001 — host array / deleted buffer
+        return []
+    if not ids:
+        return []
+    return [ids[-1]] if len(ids) > 1 else ids
+
+
+class IntegrityChecker:
+    """Per-model driver of the integrity layer (``GBDTModel._integrity``
+    — constructed only when ``integrity_check_freq > 0``).  Owned by the
+    one training thread; no locking.
+
+    ``shadow_fn`` is the independently-jitted twin of the model's
+    grower (``grower.make_shadow_grower``); for redundancy-only
+    learners (dp/voting/feature, whose growers are built per-topology)
+    it may be the primary grower itself — still a full recompute, just
+    not a second trace — flagged by ``independent=False`` and recorded
+    in the manifest."""
+
+    def __init__(self, config, shadow_fn: Callable, independent: bool):
+        self.freq = int(config.integrity_check_freq)
+        self.policy = str(config.integrity_policy)
+        self.ulp_tol = int(config.integrity_ulp_tol)
+        self.shadow_fn = shadow_fn
+        self.independent = bool(independent)
+        self.checks = 0
+        self.transients = 0
+        # newest 1-based iteration whose grow passed a shadow compare
+        self.verified_iteration = 0
+        # retained state for the snapshot-boundary check:
+        # (it_global, host_small, run_shadow_closure)
+        self._pending: Optional[Tuple[int, Any, Callable]] = None
+        self._take = None     # lazily-jitted independent score gather
+
+    def should_check(self, it_global: int) -> bool:
+        """Whether iteration ``it_global`` (0-based) is a shadow-compare
+        iteration."""
+        return self.freq > 0 and (it_global + 1) % self.freq == 0
+
+    # -- grow-path verification ------------------------------------------
+
+    def verify_grow(self, model, it_global: int, grow: Callable,
+                    run_shadow: Callable, arrays, host_small,
+                    inv_ok: bool, shadow_host):
+        """Called right after the consolidated fetch with the traced
+        invariant flag and (on check iterations) the fetched shadow
+        tree.  Returns the ``(arrays, host_small)`` to commit — the
+        originals on a clean check, the re-run's on an absorbed
+        transient.  Raises :class:`IntegrityFailure` on sticky."""
+        div: List[Dict[str, Any]] = []
+        if shadow_host is not None:
+            self.checks += 1
+            _metrics().counter("integrity.checks", path="grow").inc()
+            div = compare_tree_arrays(host_small, shadow_host, self.ulp_tol)
+        if inv_ok and not div:
+            if shadow_host is not None:
+                self.verified_iteration = it_global + 1
+            self._pending = (it_global, host_small, run_shadow)
+            return arrays, host_small
+        self._mismatch(model, it_global, inv_ok, div)
+        # re-check once, fresh primary + fresh shadow (the injection
+        # counters advance, so a single-hit transient is clean here)
+        a2 = grow()
+        inv2 = invariant_flags(a2)
+        s2 = run_shadow(self.shadow_fn)
+        small2 = a2._replace(leaf_of_row=a2.num_leaves)
+        h2, inv2_ok, sh2 = model._eget(
+            (small2, inv2, s2._replace(leaf_of_row=s2.num_leaves)),
+            "integrity_recheck")
+        div2 = compare_tree_arrays(h2, sh2, self.ulp_tol)
+        if bool(inv2_ok) and not div2:
+            self._absorb(it_global)
+            self._pending = (it_global, h2, run_shadow)
+            return a2, h2
+        self._sticky(model, it_global, div2 or div, a2.num_leaves)
+
+    def _mismatch(self, model, it_global: int, inv_ok: bool,
+                  div: List[Dict[str, Any]]) -> None:
+        _metrics().counter("integrity.mismatches", path="grow").inc()
+        Log.warning(
+            f"integrity: mismatch at iteration {it_global + 1} "
+            f"(invariants {'ok' if inv_ok else 'TRIPPED'}, "
+            f"{len(div)} divergent field(s): "
+            f"{[d['field'] for d in div]}); re-checking once")
+        bbox = getattr(model, "_bbox", None)
+        if bbox is not None:
+            bbox.record(event="integrity_mismatch",
+                        iteration=it_global + 1,
+                        invariants_ok=bool(inv_ok),
+                        divergences=div[:8])
+            bbox.dump("integrity_mismatch")
+
+    def _absorb(self, it_global: int) -> None:
+        self.transients += 1
+        _metrics().counter("integrity.transient_absorbed").inc()
+        self.verified_iteration = it_global + 1
+        Log.warning(
+            f"integrity: iteration {it_global + 1} re-check clean — "
+            "transient SDC absorbed (re-run result committed)")
+
+    def _sticky(self, model, it_global: int, div: List[Dict[str, Any]],
+                placed) -> None:
+        """Terminal: record, attribute, (maybe) quarantine, raise."""
+        _metrics().counter("integrity.sticky").inc()
+        ids = attribute_devices(placed)
+        bbox = getattr(model, "_bbox", None)
+        if bbox is not None:
+            bbox.record(event="integrity_sticky",
+                        iteration=it_global + 1,
+                        devices=ids, divergences=div[:8])
+        fail = IntegrityFailure(
+            detail=f"sticky SDC at iteration {it_global + 1}: "
+                   f"{len(div)} divergent field(s) "
+                   f"{[d['field'] for d in div][:4]}, "
+                   f"suspect devices {ids}",
+            iteration=it_global + 1, devices=tuple(ids),
+            divergences=tuple(div[:8]))
+        _on_failure(fail, site="integrity")
+        if self.policy == "quarantine" and ids:
+            mark_suspect(ids)
+            _metrics().counter("integrity.quarantined").inc()
+            Log.warning(f"integrity: quarantined device(s) {ids}")
+        raise fail
+
+    # -- score-path verification -----------------------------------------
+
+    def verify_score(self, model, lv_dev, leaf_of_row, delta,
+                     it_global: int):
+        """Shadow-verify the score-update gather on check iterations:
+        recompute ``take(leaf_values, leaf_of_row)`` through an
+        independently-jitted gather and compare ON DEVICE — the fetch
+        is one scalar, and only on check iterations (steady state stays
+        sync-free).  Same transient/sticky ladder as the grow path."""
+        import jax
+        import jax.numpy as jnp
+        if self._take is None:
+            self._take = jax.jit(lambda lv, r: jnp.take(lv, r))
+        self.checks += 1
+        _metrics().counter("integrity.checks", path="score").inc()
+        bad = model._eget(jnp.any(self._take(lv_dev, leaf_of_row)
+                                  != delta), "integrity_score")
+        if not bool(bad):
+            return delta
+        _metrics().counter("integrity.mismatches", path="score").inc()
+        Log.warning(
+            f"integrity: score-update mismatch at iteration "
+            f"{it_global + 1}; re-checking once")
+        from .utils import faultinject
+        d2 = jnp.take(lv_dev, leaf_of_row)
+        if faultinject.enabled():
+            d2 = faultinject.maybe_bitflip("score_sdc", d2)
+        bad2 = model._eget(jnp.any(self._take(lv_dev, leaf_of_row)
+                                   != d2), "integrity_recheck")
+        if not bool(bad2):
+            self.transients += 1
+            _metrics().counter("integrity.transient_absorbed").inc()
+            Log.warning(
+                f"integrity: score re-check at iteration "
+                f"{it_global + 1} clean — transient SDC absorbed")
+            return d2
+        self._sticky(model, it_global,
+                     [{"field": "score_delta", "count": -1, "index": -1,
+                       "got": 0.0, "want": 0.0, "ulp": -1}], delta)
+
+    # -- snapshot-boundary check + manifest stamp ------------------------
+
+    def boundary_check(self, model) -> None:
+        """Shadow-verify the newest committed grow right before a
+        snapshot is written, so the manifest's ``integrity`` stamp
+        means 'last check clean AT this snapshot'.  Re-runs ONLY the
+        shadow against the retained fetched primary — it consumes no
+        injection hits, and a boundary that lands on a just-checked
+        iteration is free.  A mismatch here is sticky by construction
+        (the primary's tree is already committed): one shadow re-run
+        separates a shadow-side transient, then :class:`IntegrityFailure`.
+        """
+        if self._pending is None:
+            return
+        it_g, host_small, run_shadow = self._pending
+        if self.verified_iteration >= it_g + 1:
+            return
+        self.checks += 1
+        _metrics().counter("integrity.checks", path="boundary").inc()
+        for attempt in range(2):
+            s = run_shadow(self.shadow_fn)
+            sh = model._eget(s._replace(leaf_of_row=s.num_leaves),
+                             "integrity_boundary")
+            div = compare_tree_arrays(host_small, sh, self.ulp_tol)
+            if not div:
+                self.verified_iteration = it_g + 1
+                return
+            if attempt == 0:
+                self._mismatch(model, it_g, True, div)
+        self._sticky(model, it_g, div, host_small.num_leaves)
+
+    def manifest(self, iteration: int) -> Dict[str, Any]:
+        """The snapshot manifest's ``integrity`` stamp.  ``verified``
+        means the snapshot's newest tree passed a shadow compare (the
+        boundary check runs first, so this is normally True; False
+        survives only if the boundary check could not run, e.g. no
+        retained state after resume)."""
+        return {
+            "verified": bool(self.verified_iteration >= int(iteration)),
+            "checked_iteration": int(self.verified_iteration),
+            "checks": int(self.checks),
+            "transients": int(self.transients),
+            "check_freq": int(self.freq),
+            "independent_trace": bool(self.independent),
+        }

@@ -601,6 +601,7 @@ class GBDTModel:
                     f"(at most {cap} rows).  Use {hint}quant_train="
                     "false.")
 
+        mg_kwargs = None   # set on the masked-learner path (integrity shadow)
         if dist == "data":
             from ..parallel.data_parallel import make_dp_grower
             self.grower = make_dp_grower(
@@ -669,7 +670,9 @@ class GBDTModel:
             if hist_reduce is not None and self._quant is not None:
                 user_reduce = hist_reduce
                 hist_reduce = lambda h, scales=None: user_reduce(h)  # noqa: E731
-            self.grower = make_grower(
+            # kwargs captured so the integrity layer can build an
+            # independently-jitted shadow twin of this exact grower
+            mg_kwargs = dict(
                 num_leaves=config.num_leaves, num_bins=self.max_bin,
                 params=self.split_params, max_depth=config.max_depth,
                 block_rows=self._block_rows, hist_reduce=hist_reduce,
@@ -686,6 +689,7 @@ class GBDTModel:
                 bynode_seed=config.feature_fraction_seed + 1,
                 cegb=self._cegb_state,
                 padded_leaves=self._leaf_pad)
+            self.grower = make_grower(**mg_kwargs)
 
         if config.linear_tree and config.boosting not in ("gbdt", "gbrt"):
             raise ValueError("linear_tree requires boosting=gbdt")
@@ -716,6 +720,32 @@ class GBDTModel:
         self._rng_feat = np.random.RandomState(config.feature_fraction_seed)
         self._goss = config.data_sample_strategy == "goss"
         self._last_iter_state: Optional[dict] = None
+
+        # computation-integrity layer (lightgbm_tpu/integrity.py): None
+        # unless integrity_check_freq > 0 — the hot paths only test for
+        # None, so the default adds zero work and zero syncs
+        self._integrity = None
+        if config.integrity_check_freq > 0:
+            from ..integrity import IntegrityChecker
+            if mg_kwargs is not None:
+                # masked learner: a second trace of the same logical
+                # math — jax.jit over the unjitted grower, deliberately
+                # bypassing the shared-grower memo
+                from ..grower import make_shadow_grower
+                shadow, independent = make_shadow_grower(**mg_kwargs), True
+            elif dist in ("data", "voting", "feature"):
+                # distributed growers are built per-topology around
+                # collectives: the shadow is the SAME program re-run —
+                # a full redundant recompute rather than a second
+                # trace (manifest records independent_trace=false)
+                shadow, independent = self.grower, False
+            else:
+                raise ValueError(
+                    "integrity_check_freq > 0 is unsupported with "
+                    "tpu_learner=partitioned: its grower keeps host-side "
+                    "pool/RNG state, so a shadow re-execution is not a "
+                    "pure recompute.  Use the masked learner")
+            self._integrity = IntegrityChecker(config, shadow, independent)
 
         # telemetry (obs/): None when telemetry=false — the hot paths
         # below only ever test this for None, so the default adds zero
@@ -988,6 +1018,22 @@ class GBDTModel:
             if fail is not None:
                 raise fail from e
             raise
+        if elastic:
+            # suspect-device quarantine (integrity.py sticky SDC): a
+            # quarantined chip is excluded from the claimed list, so
+            # the ladder's "sdc" rung runs mesh-minus-suspects.  Never
+            # filter down to nothing — with every device suspect the
+            # serial rung re-trusts the least-recently-accused
+            from ..parallel import elastic as elastic_mod
+            sus = elastic_mod.suspected_devices()
+            if sus:
+                keep = [d for d in devs
+                        if getattr(d, "id", None) not in sus]
+                if keep and len(keep) < len(devs):
+                    Log.warning(
+                        f"excluding {len(devs) - len(keep)} quarantined "
+                        f"suspect device(s) {sorted(sus)} from the mesh")
+                    devs = keep
         if config.mesh_shape and len(config.mesh_shape) > 1:
             # the tree learners shard exactly one axis (rows OR features);
             # a multi-dim mesh has no meaning here, so reject it loudly
@@ -1023,6 +1069,24 @@ class GBDTModel:
             return self._elastic.guarded_get(x, self._elastic_timeout,
                                              site=site)
         return jax.device_get(x)
+
+    def integrity_boundary_check(self) -> None:
+        """Shadow-verify the newest committed tree right before a
+        snapshot is written (engine.py calls this ahead of
+        ``write_snapshot``), so the manifest's ``integrity`` stamp means
+        'last check clean AT this snapshot'.  No-op when the integrity
+        layer is off or the newest tree already passed a check.  Raises
+        ``IntegrityFailure`` on a sticky boundary mismatch."""
+        if self._integrity is not None:
+            self._integrity.boundary_check(self)
+
+    def integrity_manifest(self, iteration: int):
+        """The snapshot manifest's ``integrity`` stamp dict, or None
+        when the integrity layer is off (manifests stay byte-identical
+        to pre-integrity ones at ``integrity_check_freq=0``)."""
+        if self._integrity is None:
+            return None
+        return self._integrity.manifest(iteration)
 
     def snapshot_state(self):
         """``(score, fingerprint_override)`` for snapshot.write_snapshot.
@@ -1390,9 +1454,12 @@ class GBDTModel:
         per-iteration path: host-side injection sites cannot fire inside
         a fused device program.  Path choice only — numerics are still
         governed by ``_fusable_config``, so injected and clean runs train
-        identical models."""
+        identical models.  The integrity layer likewise forces the
+        per-iteration path: its shadow compares and transient re-runs
+        are host-driven."""
         return (self.config.fused_chunk > 1 and self._fusable_config()
-                and not self._faults_active())
+                and not self._faults_active()
+                and self._integrity is None)
 
     @staticmethod
     def _faults_active() -> bool:
@@ -1452,6 +1519,11 @@ class GBDTModel:
             reasons.append(
                 "fault injection active: host-side injection sites "
                 "cannot fire inside a fused device program")
+        if self._integrity is not None:
+            reasons.append(
+                "integrity_check_freq > 0: the computation-integrity "
+                "layer's shadow compares and transient re-runs are "
+                "host-driven (docs/Fault-Tolerance.md layer 7)")
         return reasons
 
     def _fused_chunk_fn(self):
@@ -2608,41 +2680,78 @@ class GBDTModel:
                     gkw["max_leaves"] = jnp.int32(cfg.num_leaves)
             vals_g = self._prep_vals(vals)
             fmask_g = self._prep_fmask(fmask)
+
+            def _run_grow(fn):
+                if self._dist == "feature":
+                    return fn(self.binned_dev, vals_g, fmask_g,
+                              self._nb_grow, self._na_grow,
+                              self._na_grow, **gkw)
+                return fn(self.binned_dev, vals_g, fmask_g,
+                          self._nb_grow, self._na_grow, **gkw)
+
+            def _grow():
+                a = _run_grow(self.grower)
+                if faultinject.enabled():
+                    # SDC chaos substrate (integrity.py tests/soak): one
+                    # deterministic bit of the new tree's leaf-count
+                    # array flips when hist_sdc fires (leaf 0: always a
+                    # live slot)
+                    a = a._replace(leaf_count=faultinject.maybe_bitflip(
+                        "hist_sdc", a.leaf_count, index=0))
+                if self._pc > 1 and self._dist is not None:
+                    # multi-process: the grower returned GLOBAL arrays
+                    # (tree fields replicated, leaf_of_row row-sharded).
+                    # Mixing them into this process's local score/valid
+                    # math would make every later eager op a
+                    # cross-process collective, so re-materialize
+                    # everything process-locally: tree fields via one
+                    # replicated fetch, this process's leaf_of_row rows
+                    # from its own addressable shards.
+                    sm = a._replace(leaf_of_row=a.num_leaves)
+                    host_g = self._eget(sm, "fetch")
+                    a = jax.tree.map(jnp.asarray, host_g)._replace(
+                        leaf_of_row=self._localize_rows(a.leaf_of_row))
+                elif self._row_pad:
+                    # drop padded rows before any host/score use of the
+                    # row->leaf vector
+                    a = a._replace(
+                        leaf_of_row=a.leaf_of_row[:self.num_data])
+                return a
+
             if obs is not None:
                 _sp = obs.phase("grow", self.iter_)
-            if self._dist == "feature":
-                arrays = self.grower(self.binned_dev, vals_g, fmask_g,
-                                     self._nb_grow, self._na_grow,
-                                     self._na_grow, **gkw)
-            else:
-                arrays = self.grower(self.binned_dev, vals_g, fmask_g,
-                                     self._nb_grow, self._na_grow, **gkw)
+            arrays = _grow()
             if obs is not None:
                 obs.phase_metric("grow", _sp.end(arrays.num_leaves))
                 _sp = obs.phase("fetch", self.iter_)
-            if self._pc > 1 and self._dist is not None:
-                # multi-process: the grower returned GLOBAL arrays (tree
-                # fields replicated, leaf_of_row row-sharded).  Mixing
-                # them into this process's local score/valid math would
-                # make every later eager op a cross-process collective,
-                # so re-materialize everything process-locally: tree
-                # fields via one replicated fetch, this process's
-                # leaf_of_row rows from its own addressable shards.
-                small = arrays._replace(leaf_of_row=arrays.num_leaves)
-                host_g = self._eget(small, "fetch")
-                arrays = jax.tree.map(jnp.asarray, host_g)._replace(
-                    leaf_of_row=self._localize_rows(arrays.leaf_of_row))
-            elif self._row_pad:
-                # drop padded rows before any host/score use of the
-                # row->leaf vector
-                arrays = arrays._replace(
-                    leaf_of_row=arrays.leaf_of_row[:self.num_data])
             # ONE batched host transfer of the tree-sized fields; the [N]
             # leaf_of_row stays on device (only pulled when renew/linear
             # paths need it) — matters when the chip is behind a tunnel
+            ichk = self._integrity
+            check_now = False
             small = arrays._replace(leaf_of_row=arrays.num_leaves)
-            host = self._eget(small, "fetch") \
-                ._replace(leaf_of_row=arrays.leaf_of_row)
+            if ichk is None:
+                host = self._eget(small, "fetch") \
+                    ._replace(leaf_of_row=arrays.leaf_of_row)
+            else:
+                # integrity layer (lightgbm_tpu/integrity.py): the
+                # traced invariant flag — and, on check iterations, the
+                # independently-jitted shadow re-execution — rides the
+                # SAME consolidated fetch, so steady state gains zero
+                # extra host syncs
+                from .. import integrity as integrity_mod
+                check_now = ichk.should_check(it_global)
+                shadow_small = None
+                if check_now:
+                    s = _run_grow(ichk.shadow_fn)
+                    shadow_small = s._replace(leaf_of_row=s.num_leaves)
+                inv_dev = integrity_mod.invariant_flags(arrays)
+                host_small, inv_ok, shadow_host = self._eget(
+                    (small, inv_dev, shadow_small), "fetch")
+                arrays, host_small = ichk.verify_grow(
+                    self, it_global, _grow, _run_grow, arrays,
+                    host_small, bool(inv_ok), shadow_host)
+                host = host_small._replace(leaf_of_row=arrays.leaf_of_row)
             if obs is not None:
                 # device_get blocks by itself; no fence needed
                 obs.phase_metric("fetch", _sp.end())
@@ -2758,6 +2867,15 @@ class GBDTModel:
                 # score update via row->leaf gather (no traversal needed)
                 lv_dev = jnp.asarray(dev_values, jnp.float32)
                 delta = jnp.take(lv_dev, arrays.leaf_of_row)
+                if faultinject.enabled():
+                    delta = faultinject.maybe_bitflip("score_sdc", delta)
+                if check_now:
+                    # covers the on-device row partition + gather that
+                    # the tree-sized fetch can't see; one extra scalar
+                    # sync on CHECK iterations only
+                    delta = ichk.verify_score(
+                        self, lv_dev, arrays.leaf_of_row, delta,
+                        it_global)
                 self.score = self.score.at[:, k].add(delta)
             if obs is not None:
                 obs.phase_metric("score", _sp.end(self.score))

@@ -237,3 +237,21 @@ def masked_histogram(binned: jax.Array, vals: jax.Array, leaf_of_row: jax.Array,
     mask = (leaf_of_row == leaf).astype(vals.dtype)[:, None]
     return compute_histogram(binned, vals * mask, num_bins=num_bins,
                              block_rows=block_rows)
+
+
+def feature_totals_residual(hist: jax.Array, vals: jax.Array) -> jax.Array:
+    """Max absolute residual of the histogram's defining invariant:
+    summing a feature's bins must reproduce the column totals of the
+    accumulands, ``sum_b hist[f, b, c] == sum_n vals[n, c]`` for every
+    feature ``f`` — the one-hot rows partition the rows exactly once.
+
+    A scalar 0 (int accumulands) or ~rounding-sized value (f32) on a
+    healthy device; a bit flip anywhere in the contraction shows up as
+    a residual the size of the flipped magnitude.  Used by the
+    integrity layer (lightgbm_tpu/integrity.py) as an attribution probe
+    when a sticky histogram mismatch is being blackbox-dumped, and by
+    the unit tests as a direct oracle on :func:`compute_histogram`.
+    """
+    tot = jnp.sum(hist, axis=1)                     # [F, C]
+    col = jnp.sum(vals.astype(hist.dtype), axis=0)  # [C]
+    return jnp.max(jnp.abs(tot - col[None, :]))

@@ -78,7 +78,7 @@ import numpy as np
 from ..obs.metrics import MetricsRegistry
 
 FAILURE_KINDS = ("collective_timeout", "host_loss", "claim_wedge",
-                 "bringup", "ingest")
+                 "bringup", "ingest", "sdc")
 
 # process-level elastic metrics: always-on and host-side only (a few
 # counter bumps per failure — nothing per-iteration), so they need no
@@ -388,6 +388,53 @@ def current() -> Optional[ElasticContext]:
         return _ctx
 
 
+# ---------------------------------------------------------------------------
+# Suspect-device quarantine (lightgbm_tpu/integrity.py sticky SDC)
+# ---------------------------------------------------------------------------
+# Device ids attributed to a sticky silent-data-corruption failure.
+# GBDTModel._resolve_mesh excludes them from the next claimed mesh and
+# the ladder's "sdc" rung shrinks by exactly the suspect count (full
+# mesh -> mesh-minus-suspects -> ... -> serial) instead of halving.
+# Guarded by _suspect_lock; reads return an immutable copy.
+_suspect_lock = threading.Lock()
+_suspects: set = set()
+
+
+def mark_suspect(device_ids) -> None:
+    """Record devices attributed to a sticky SDC failure (quarantine)."""
+    with _suspect_lock:
+        for d in device_ids:
+            _suspects.add(int(d))
+        n = len(_suspects)
+    _metrics().gauge("elastic.suspect_devices").set(n)
+
+
+def suspected_devices() -> frozenset:
+    """Immutable snapshot of the quarantined device ids."""
+    with _suspect_lock:
+        return frozenset(_suspects)
+
+
+def clear_suspects() -> None:
+    """Drop all quarantine state (fresh elastic_train run / tests)."""
+    with _suspect_lock:
+        _suspects.clear()
+    _metrics().gauge("elastic.suspect_devices").set(0)
+
+
+def sdc_shrunk(n: int) -> int:
+    """Next data-parallel rung after a sticky-SDC failure: drop exactly
+    the quarantined suspects (full mesh -> mesh-minus-suspects — the
+    healthy chips keep their shards; ``GBDTModel._resolve_mesh`` picks
+    WHICH ids go) and fall back to the ladder's usual halving when
+    attribution produced no suspects (``integrity_policy`` raise/rewind,
+    or a host-array divergence with no placement)."""
+    sus = len(suspected_devices())
+    if sus:
+        return max(1, int(n) - sus)
+    return max(1, int(n) // 2)
+
+
 def _record_event(event: str, **fields) -> None:
     """One JSONL failure/recovery event + the elastic.* metric bump.
     Best-effort: observability must never turn a recoverable failure
@@ -557,6 +604,9 @@ def elastic_train(params: dict, x, y=None, *, weight=None,
     ctx = ElasticContext(heartbeat, monitor,
                          events_path=cfg0.output_model + ".elastic.jsonl")
     install(ctx)
+    # quarantine state is per-run: a fresh ladder starts trusting every
+    # device again (suspects re-earn their place or re-fail the check)
+    clear_suspects()
 
     report = {"attempts": 0, "shrinks": 0, "recoveries": 0,
               "failures": [], "rungs": []}
@@ -610,7 +660,7 @@ def elastic_train(params: dict, x, y=None, *, weight=None,
         return Dataset(x, label=y, weight=weight, params=dict(pp),
                        bin_mappers=mcache["mappers"])
 
-    def _shrunk(topo: Optional[int]) -> int:
+    def _shrunk(topo: Optional[int], kind: Optional[str] = None) -> int:
         if cfg0.tree_learner != "data":
             # voting's per-shard top-k votes are topology-dependent and
             # a serial-learner run has no mesh to shrink — the only
@@ -626,6 +676,8 @@ def elastic_train(params: dict, x, y=None, *, weight=None,
             req = _requested_devices(cfg0)
             if req is not None:
                 n = min(n, req)
+        if kind == "sdc":
+            return sdc_shrunk(n)
         return max(1, int(n) // 2)
 
     topo: Optional[int] = None       # None = as requested (rung 0)
@@ -698,8 +750,10 @@ def elastic_train(params: dict, x, y=None, *, weight=None,
                         f"({recover_budget:g}s) exhausted; giving up")
                     raise
                 rung_attempts += 1
-                if kind == "host_loss" or rung_attempts > retries:
-                    new_topo = _shrunk(topo)
+                # host_loss and sticky SDC shrink immediately: retrying
+                # the same topology re-runs on the dead/suspect device
+                if kind in ("host_loss", "sdc") or rung_attempts > retries:
+                    new_topo = _shrunk(topo, kind)
                     if topo is not None and new_topo >= topo:
                         raise     # serial rung failed: ladder exhausted
                     topo = new_topo

@@ -63,6 +63,11 @@ _VOLATILE = {
     # raising them is the NATURAL response to the crash being resumed
     # from — they must not invalidate the snapshot
     "dist_init_retries", "dist_init_timeout_s", "dist_fallback_serial",
+    # computation-integrity knobs (lightgbm_tpu/integrity.py): checks
+    # and transient-absorbed re-runs are byte-identical to unchecked
+    # training, and turning detection ON is the natural response to
+    # the corruption being resumed from
+    "integrity_check_freq", "integrity_policy", "integrity_ulp_tol",
 }
 
 # Topology keys, volatile ONLY under elastic training
@@ -243,6 +248,17 @@ def write_snapshot(booster, prev_booster, cfg, iteration: int,
         "model_sha256": sha256_hex(text_bytes),
         "state_sha256": sha256_hex(buf.getvalue()),
     }
+    # computation-integrity stamp (lightgbm_tpu/integrity.py): present
+    # only when integrity_check_freq > 0, so manifests stay
+    # byte-identical to pre-integrity ones with the layer off.
+    # ``verified`` means the snapshot's newest tree passed a shadow
+    # compare (engine runs integrity_boundary_check first) — the stamp
+    # find_latest_snapshot prefers when choosing a rewind target
+    int_fn = getattr(booster._model, "integrity_manifest", None)
+    if int_fn is not None:
+        stamp = int_fn(int(iteration))
+        if stamp is not None:
+            manifest["integrity"] = stamp
     atomic_write(base, text_bytes, binary=True)
     atomic_write(base + ".state.npz", buf.getvalue(), binary=True)
     # manifest last: its presence marks the snapshot complete
@@ -324,9 +340,18 @@ def find_latest_snapshot(output_model: str, signature: str,
     overrides the shard's own fingerprint: elastic multi-process
     manifests are stamped with the GLOBAL data fingerprint
     (``GBDTModel.snapshot_state``), which the shard hash would never
-    match."""
+    match.
+
+    Integrity preference (lightgbm_tpu/integrity.py): among valid
+    candidates, the newest whose manifest carries an
+    ``integrity.verified == true`` stamp wins over a NEWER valid but
+    unverified one — an SDC rewind must never land on a snapshot whose
+    history could itself be corrupt.  With no verified candidate (or
+    no integrity stamps at all, the ``integrity_check_freq=0`` world)
+    the newest valid snapshot is returned exactly as before."""
     fp = getattr(train_set, "elastic_global_fingerprint", None) \
         or train_set.fingerprint()
+    fallback: Optional[Tuple[int, str, np.ndarray]] = None
     for it, path in _list_snapshots(output_model):
         man_path = path + ".manifest.json"
         try:
@@ -363,5 +388,21 @@ def find_latest_snapshot(output_model: str, signature: str,
             Log.warning(f"snapshot {path} skipped: manifest iteration "
                         f"{man.get('iteration')} != filename {it}")
             continue
+        stamp = man.get("integrity")
+        if isinstance(stamp, dict) and not stamp.get("verified", False):
+            # valid but integrity-UNVERIFIED: hold as the fallback and
+            # keep walking for an older verified snapshot
+            if fallback is None:
+                fallback = (it, path, score)
+            Log.warning(f"snapshot {path} is not integrity-verified; "
+                        "looking for an older verified snapshot")
+            continue
+        if fallback is not None:
+            Log.warning(
+                f"resuming from integrity-verified snapshot iter {it} "
+                f"instead of newer unverified iter {fallback[0]}")
         return it, path, score
-    return None
+    if fallback is not None:
+        Log.warning(f"no integrity-verified snapshot found; resuming "
+                    f"from unverified iter {fallback[0]}")
+    return fallback

@@ -21,8 +21,12 @@ Spec grammar — comma-separated ``site:hits[:action]`` entries:
   ``LGBM_TPU_FAULT_HANG_S`` seconds, default 30 — the wedged-collective
   / wedged-claim simulation the elastic deadline layer exists to
   bound; the sleeping thread is abandoned by the watchdog exactly like
-  a real wedge).  Site ``snapshot_kill`` defaults to ``kill``; sites
-  ``collective_hang`` and ``claim_wedge`` default to ``hang``.
+  a real wedge), or ``bitflip`` (one deterministic bit of the named
+  device array flips at the site — only meaningful at the SDC sites
+  wired through :func:`maybe_bitflip`).  Site ``snapshot_kill``
+  defaults to ``kill``; sites ``collective_hang`` and ``claim_wedge``
+  default to ``hang``; sites ``hist_sdc`` and ``score_sdc`` default to
+  ``bitflip``.
 
 Sites wired into the codebase:
 
@@ -86,6 +90,20 @@ Sites wired into the codebase:
                     default action ``hang``: a reader wedged on a dead
                     filesystem; the ``ingest_read_timeout_s`` watchdog
                     must abandon + classify it
+``hist_sdc``        silent-data-corruption injection into the grower's
+                    histogram-derived output (``models/gbdt
+                    .GBDTModel.train_one_iter`` via
+                    :func:`maybe_bitflip`) — default action
+                    ``bitflip``: ONE deterministic bit of the new
+                    tree's leaf-count array flips, simulating a
+                    marginal chip; exercises the integrity layer's
+                    detect / transient-absorb / rewind / quarantine
+                    ladder (lightgbm_tpu/integrity.py)
+``score_sdc``       silent-data-corruption injection into the
+                    per-iteration score-update delta (``models/gbdt
+                    .GBDTModel.train_one_iter``) — default action
+                    ``bitflip``; exercises the integrity layer's
+                    score-path verification
 ==================  ========================================================
 
 Also exercisable from ``tools/tpu_watch.py`` probes: export
@@ -106,10 +124,15 @@ KNOWN_SITES = ("device_claim", "collective", "snapshot_write",
                "continual_boost", "continual_publish",
                "continual_promote", "shadow_probe", "collective_hang",
                "host_loss", "claim_wedge", "ingest_read",
-               "ingest_checksum", "ingest_hang")
+               "ingest_checksum", "ingest_hang", "hist_sdc",
+               "score_sdc")
 
 # sites whose realistic failure mode is a WEDGE, not an error
 _HANG_DEFAULT_SITES = ("collective_hang", "claim_wedge", "ingest_hang")
+
+# sites whose realistic failure mode is SILENT data corruption — the
+# chip keeps running and hands back a wrong number (maybe_bitflip)
+_BITFLIP_DEFAULT_SITES = ("hist_sdc", "score_sdc")
 
 # how long a firing ``hang`` action blocks: long enough that any sane
 # deadline fires first, short enough that an abandoned daemon thread
@@ -175,12 +198,14 @@ def configure(spec: Optional[str]) -> None:
             action = "kill"
         elif site in _HANG_DEFAULT_SITES:
             action = "hang"
+        elif site in _BITFLIP_DEFAULT_SITES:
+            action = "bitflip"
         else:
             action = "raise"
         if site not in KNOWN_SITES:
             raise ValueError(f"unknown fault site {site!r} "
                              f"(known: {', '.join(KNOWN_SITES)})")
-        if action not in ("raise", "kill", "exit", "hang"):
+        if action not in ("raise", "kill", "exit", "hang", "bitflip"):
             raise ValueError(f"unknown fault action {action!r}")
         if "-" in hits:
             lo_s, hi_s = hits.split("-", 1)
@@ -249,6 +274,64 @@ def fires(site: str) -> bool:
         return False
     fire, _n, _action = _advance(site)
     return fire
+
+
+def maybe_bitflip(site: str, arr, index: Optional[int] = None):
+    """SDC injection: count a hit at ``site``; when it fires with action
+    ``bitflip``, return ``arr`` with exactly ONE bit flipped.  Element
+    and bit are chosen deterministically from ``crc32(site:hit)`` so a
+    given spec replays the identical corruption run to run; ``index``
+    pins the element instead (e.g. ``hist_sdc`` flips leaf 0's count —
+    a slot that is always live).  For int32 operands the bit is drawn
+    from [0, 31); for float32 from [8, 31) — at least 256 ulps, so a
+    flip is never hidden inside ``integrity_ulp_tol`` — and the sign
+    bit is left alone either way so a float flip stays a plausible
+    wrong *number*, not a sign glitch.
+
+    Returns ``arr`` unchanged — the SAME object, no device work — when
+    injection is off, the site is unarmed, or this hit does not fire.
+    A non-``bitflip`` action on an armed SDC site still applies (e.g.
+    ``hist_sdc:3:kill`` dies at the site instead of corrupting it).
+    """
+    if site not in _spec:
+        return arr
+    fire, n, action = _advance(site)
+    if not fire:
+        return arr
+    if action != "bitflip":
+        if action == "exit":
+            os._exit(23)
+        if action == "kill":
+            raise InjectedKill(site, n)
+        if action == "hang":
+            import time
+            time.sleep(_hang_seconds())
+            return arr
+        raise InjectedFault(site, n)
+    import zlib
+
+    import jax
+    import jax.numpy as jnp
+    seed = zlib.crc32(f"{site}:{n}".encode())
+    flat = jnp.ravel(arr)
+    size = max(int(flat.shape[0]), 1)
+    idx = (seed if index is None else int(index)) % size
+    bit = (seed >> 8) % 31
+    if jnp.issubdtype(flat.dtype, jnp.floating):
+        bit = 8 + (seed >> 8) % 23      # >= 256 ulps: never tol-masked
+    mask = jnp.int32(1 << bit)
+    if jnp.issubdtype(flat.dtype, jnp.floating):
+        iv = jax.lax.bitcast_convert_type(
+            flat.astype(jnp.float32), jnp.int32)
+        iv = iv.at[idx].set(iv[idx] ^ mask)
+        flat = jax.lax.bitcast_convert_type(
+            iv, jnp.float32).astype(arr.dtype)
+    elif jnp.issubdtype(flat.dtype, jnp.integer):
+        flat = flat.at[idx].set(flat[idx] ^ mask.astype(flat.dtype))
+    else:
+        raise TypeError(f"maybe_bitflip: unsupported dtype "
+                        f"{flat.dtype} at site '{site}'")
+    return flat.reshape(jnp.shape(arr))
 
 
 # arm from the environment at import (subprocess tests / tpu_watch probes)

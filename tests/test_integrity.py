@@ -1,0 +1,367 @@
+"""Computation-integrity layer (lightgbm_tpu/integrity.py; ISSUE 20).
+
+Covers the comparison primitives (ulp distance, field-by-field
+TreeArrays compare, traced invariants), the seeded ``bitflip`` SDC
+injection, the steady-state contracts (``integrity_check_freq=0`` adds
+ZERO host syncs; ``integrity_check_freq>0`` trains byte-identical
+trees), the transient-vs-sticky ladder on both the grow and score
+paths, policy ``rewind`` (engine re-enters from the newest
+integrity-VERIFIED snapshot) and policy ``quarantine`` (suspect ids
+feed the elastic ladder's mesh-minus-suspects rung), the snapshot
+finder's verified-preference, and a short SDC chaos soak
+(tools/soak_train.py sdc=1)."""
+
+import collections
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import integrity
+from lightgbm_tpu.integrity import (IntegrityFailure, compare_tree_arrays,
+                                    invariant_flags, ulp_delta)
+from lightgbm_tpu.parallel import elastic
+from lightgbm_tpu.utils import faultinject
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faultinject.clear()
+    elastic.clear_suspects()
+    integrity.reset_metrics()
+    yield
+    faultinject.clear()
+    elastic.clear_suspects()
+    integrity.reset_metrics()
+
+
+def _data(n=400, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+BASE = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+        "deterministic": True, "seed": 3, "tpu_learner": "masked"}
+
+
+def _train(extra=None, rounds=8, faults=None, n=400):
+    x, y = _data(n)
+    faultinject.configure(faults)
+    try:
+        return lgb.train(dict(BASE, **(extra or {})),
+                         lgb.Dataset(x, label=y), num_boost_round=rounds)
+    finally:
+        faultinject.configure(None)
+
+
+def _trees(bst):
+    return bst.model_to_string().split("parameters:")[0] \
+        .split("feature_infos")[1]
+
+
+def _mvals():
+    return {k: v["value"] for k, v in integrity.metrics_snapshot().items()}
+
+
+# a minimal host-side stand-in for the fields the primitives touch:
+# a 3-leaf tree -- node 0 splits into node 1 and leaf 0, node 1 into
+# leaves 1 and 2 (child < 0 encodes leaf ~child)
+_T = collections.namedtuple(
+    "_T", ["num_leaves", "left_child", "right_child", "leaf_count",
+           "internal_count", "split_gain", "leaf_of_row"])
+
+
+def _tiny_tree(**over):
+    t = _T(num_leaves=np.int32(3),
+           left_child=np.array([1, ~1], np.int32),
+           right_child=np.array([~0, ~2], np.int32),
+           leaf_count=np.array([100., 60., 40., 0.], np.float32),
+           internal_count=np.array([200., 100.], np.float32),
+           split_gain=np.array([1.5, 0.25], np.float32),
+           leaf_of_row=np.int32(3))
+    return t._replace(**over)
+
+
+# ---------------------------------------------------------------------------
+# Comparison primitives
+# ---------------------------------------------------------------------------
+
+class TestPrimitives:
+    def test_ulp_delta(self):
+        a = np.array([1.0, 0.0, np.nan, 2.0], np.float32)
+        assert ulp_delta(a, a.copy()).tolist() == [0, 0, 0, 0]
+        # -0.0 == +0.0 and NaN pairs count as equal
+        assert int(ulp_delta(np.float32(-0.0), np.float32(0.0)).item()) == 0
+        # adjacent floats are exactly 1 ulp apart
+        b = np.nextafter(a[:1], np.float32(2.0), dtype=np.float32)
+        assert int(ulp_delta(a[:1], b)[0]) == 1
+        # a sign flip on a non-zero value is a huge distance
+        assert int(ulp_delta(np.float32(1.0),
+                             np.float32(-1.0)).item()) > 2 ** 30
+
+    def test_compare_tree_arrays(self):
+        t = _tiny_tree()
+        assert compare_tree_arrays(t, _tiny_tree()) == []
+        # int fields compare bitwise
+        div = compare_tree_arrays(
+            t, _tiny_tree(left_child=np.array([1, ~2], np.int32)))
+        assert [d["field"] for d in div] == ["left_child"]
+        assert div[0]["index"] == 1 and div[0]["count"] == 1
+        # float fields honor the ulp tolerance
+        lc = t.leaf_count.copy()
+        lc[0] = np.nextafter(lc[0], np.float32(1e9), dtype=np.float32)
+        assert compare_tree_arrays(t, _tiny_tree(leaf_count=lc),
+                                   ulp_tol=2) == []
+        div = compare_tree_arrays(t, _tiny_tree(leaf_count=lc), ulp_tol=0)
+        assert [d["field"] for d in div] == ["leaf_count"]
+        assert div[0]["ulp"] == 1
+        # the scalar leaf_of_row placeholder is never compared
+        assert compare_tree_arrays(
+            t, _tiny_tree(leaf_of_row=np.int32(99))) == []
+
+    def test_invariant_flags(self):
+        assert bool(invariant_flags(_tiny_tree()))
+        # count conservation: node 1's children no longer sum to it
+        lc = _tiny_tree().leaf_count.copy()
+        lc[1] += 8.0
+        assert not bool(invariant_flags(_tiny_tree(leaf_count=lc)))
+        # gain finiteness over live internal nodes
+        sg = np.array([np.inf, 0.25], np.float32)
+        assert not bool(invariant_flags(_tiny_tree(split_gain=sg)))
+        # a stump trivially passes (no live internal nodes)
+        assert bool(invariant_flags(_tiny_tree(
+            num_leaves=np.int32(1),
+            leaf_count=np.array([200., 0., 0., 0.], np.float32))))
+
+    def test_feature_totals_residual(self):
+        import jax.numpy as jnp
+        from lightgbm_tpu.ops.histogram import (compute_histogram,
+                                                feature_totals_residual)
+        rs = np.random.RandomState(1)
+        binned = jnp.asarray(rs.randint(0, 15, (200, 4)), jnp.uint8)
+        vals = jnp.asarray(rs.randn(200, 2), jnp.float32)
+        hist = compute_histogram(binned, vals, num_bins=16)
+        assert float(feature_totals_residual(hist, vals)) < 1e-3
+        bad = hist.at[2, 3, 1].add(64.0)
+        assert float(feature_totals_residual(bad, vals)) > 32.0
+
+    def test_maybe_bitflip_deterministic_and_detectable(self):
+        arr = np.linspace(1.0, 2.0, 16).astype(np.float32)
+        faultinject.configure("hist_sdc:1")
+        f1 = np.asarray(faultinject.maybe_bitflip("hist_sdc", arr))
+        faultinject.configure("hist_sdc:1")
+        f2 = np.asarray(faultinject.maybe_bitflip("hist_sdc", arr))
+        # seeded: the identical corruption replays run to run
+        assert f1.tobytes() == f2.tobytes()
+        diff = np.nonzero(f1 != arr)[0]
+        assert len(diff) == 1
+        # float flips land at bit >= 8: never hidden inside ulp_tol
+        assert int(ulp_delta(arr, f1).max()) >= 256
+        # int operands flip exactly one bit of one element
+        iv = np.arange(16, dtype=np.int32)
+        faultinject.configure("hist_sdc:1")
+        g = np.asarray(faultinject.maybe_bitflip("hist_sdc", iv, index=5))
+        assert bin(int(g[5] ^ iv[5])).count("1") == 1
+        assert np.array_equal(np.delete(g, 5), np.delete(iv, 5))
+        # unarmed site: the SAME object back, no hit counted
+        faultinject.configure("claim_wedge:1")
+        assert faultinject.maybe_bitflip("hist_sdc", arr) is arr
+
+
+# ---------------------------------------------------------------------------
+# Steady state: freq=0 adds nothing; freq>0 trains identical trees
+# ---------------------------------------------------------------------------
+
+class TestSteadyState:
+    def test_checked_training_is_byte_identical(self):
+        ref = _trees(_train())
+        for freq in (1, 3):
+            assert _trees(_train({"integrity_check_freq": freq})) == ref
+        m = _mvals()
+        assert m["integrity.checks{path=grow}"] == 8 + 2    # freq 1 + 3
+        assert "integrity.mismatches{path=grow}" not in m
+
+    def test_freq_zero_adds_zero_host_syncs(self):
+        # the acceptance pin: integrity_check_freq=0 must be the exact
+        # pre-integrity training loop -- same jax.device_get count as a
+        # config that never mentions integrity at all
+        import jax
+        x, y = _data()
+        counts = []
+        for extra in ({}, {"integrity_check_freq": 0}):
+            dtr = lgb.Dataset(x, label=y)
+            dtr.construct()
+            n0 = [0]
+            orig = jax.device_get
+
+            def counting(v, n0=n0):
+                n0[0] += 1
+                return orig(v)
+
+            jax.device_get = counting
+            try:
+                bst = lgb.train(dict(BASE, **extra), dtr,
+                                num_boost_round=6)
+            finally:
+                jax.device_get = orig
+            assert len(bst.trees) == 6
+            counts.append(n0[0])
+        assert counts[0] == counts[1], \
+            f"integrity_check_freq=0 changed the sync count: {counts}"
+        assert _mvals() == {}
+
+
+# ---------------------------------------------------------------------------
+# Transient vs sticky, rewind, quarantine
+# ---------------------------------------------------------------------------
+
+class TestTransientSticky:
+    def test_grow_transient_absorbed_byte_identical(self):
+        p = {"integrity_check_freq": 1}
+        ref = _trees(_train(p))
+        integrity.reset_metrics()
+        got = _trees(_train(p, faults="hist_sdc:3"))
+        assert got == ref
+        m = _mvals()
+        assert m["integrity.mismatches{path=grow}"] == 1
+        assert m["integrity.transient_absorbed"] == 1
+        assert "integrity.sticky" not in m
+
+    def test_score_transient_absorbed_byte_identical(self):
+        p = {"integrity_check_freq": 1}
+        ref = _trees(_train(p))
+        integrity.reset_metrics()
+        got = _trees(_train(p, faults="score_sdc:3"))
+        assert got == ref
+        m = _mvals()
+        assert m["integrity.mismatches{path=score}"] == 1
+        assert m["integrity.transient_absorbed"] == 1
+
+    def test_sticky_raises_classified_sdc(self):
+        # fires on the check AND on the re-check: sticky under the
+        # default raise policy -> IntegrityFailure, ElasticFailure
+        # kind "sdc", blackbox-visible divergence summary attached
+        with pytest.raises(IntegrityFailure) as ei:
+            _train({"integrity_check_freq": 1}, faults="hist_sdc:3-4")
+        e = ei.value
+        assert elastic.failure_kind(e) == "sdc"
+        assert e.iteration == 3
+        assert any(d["field"] == "leaf_count" for d in e.divergences)
+        m = _mvals()
+        assert m["integrity.sticky"] == 1
+        assert "integrity.quarantined" not in m      # raise-policy only
+
+    def test_sticky_rewind_resumes_byte_identical(self, tmp_path):
+        out = str(tmp_path / "m.txt")
+        p = {"integrity_check_freq": 1, "integrity_policy": "rewind",
+             "snapshot_freq": 2, "snapshot_keep": 0,
+             "output_model": out}
+        ref = _trees(_train(dict(p)))
+        for f in os.listdir(tmp_path):
+            os.unlink(tmp_path / f)
+        integrity.reset_metrics()
+        # hits 3+4: sticky at iteration 3 -> rewind to snapshot@2;
+        # the replay's hit 5 fires once more -> transient, absorbed
+        got = _trees(_train(dict(p), faults="hist_sdc:3-5"))
+        assert got == ref
+        m = _mvals()
+        assert m["integrity.rewinds"] == 1
+        assert m["integrity.sticky"] == 1
+        assert m["integrity.transient_absorbed"] == 1
+
+    def test_quarantine_policy_marks_suspects(self):
+        with pytest.raises(IntegrityFailure) as ei:
+            _train({"integrity_check_freq": 1,
+                    "integrity_policy": "quarantine"},
+                   faults="hist_sdc:3-4")
+        assert ei.value.devices != ()
+        assert elastic.suspected_devices() == frozenset(ei.value.devices)
+        assert _mvals()["integrity.quarantined"] == 1
+
+    def test_sdc_shrunk_drops_exactly_the_suspects(self):
+        # ladder arithmetic: full mesh -> mesh-minus-suspects (not the
+        # generic halving) once quarantine has named the chips
+        assert elastic.sdc_shrunk(8) == 4        # no suspects: halve
+        elastic.mark_suspect([5])
+        assert elastic.sdc_shrunk(8) == 7
+        elastic.mark_suspect([2, 6])
+        assert elastic.sdc_shrunk(8) == 5
+        assert elastic.sdc_shrunk(2) == 1        # floor at serial
+
+
+# ---------------------------------------------------------------------------
+# Snapshot integrity stamps and the verified-preference finder
+# ---------------------------------------------------------------------------
+
+class TestVerifiedSnapshots:
+    def _snap_run(self, tmp_path, freq):
+        out = str(tmp_path / "m.txt")
+        p = dict(BASE, integrity_check_freq=freq, snapshot_freq=2,
+                 snapshot_keep=0, output_model=out)
+        x, y = _data()
+        ds = lgb.Dataset(x, label=y)
+        lgb.train(dict(p), ds, num_boost_round=8)
+        from lightgbm_tpu.snapshot import params_signature
+        return out, params_signature(dict(p)), lgb.Dataset(x, label=y)
+
+    def test_freq_zero_manifests_carry_no_stamp(self, tmp_path):
+        out, _, _ = self._snap_run(tmp_path, 0)
+        mans = [f for f in os.listdir(tmp_path)
+                if f.endswith(".manifest.json")]
+        assert mans
+        for f in mans:
+            assert "integrity" not in json.load(open(tmp_path / f))
+
+    def test_finder_prefers_older_verified_snapshot(self, tmp_path):
+        from lightgbm_tpu.snapshot import find_latest_snapshot
+        out, sig, ds = self._snap_run(tmp_path, 1)
+        found = find_latest_snapshot(out, sig, ds)
+        assert found is not None and found[0] == 8
+        assert json.load(open(out + ".snapshot_iter_8.manifest.json")) \
+            ["integrity"]["verified"] is True
+
+        def _unverify(it):
+            mp = out + f".snapshot_iter_{it}.manifest.json"
+            man = json.load(open(mp))
+            man["integrity"]["verified"] = False
+            with open(mp, "w") as f:
+                json.dump(man, f)
+
+        # newest unverified: an older VERIFIED snapshot wins over it
+        _unverify(8)
+        found = find_latest_snapshot(out, sig, ds)
+        assert found is not None and found[0] == 6
+        # nothing verified at all: fall back to the newest valid one
+        for it in (2, 4, 6):
+            _unverify(it)
+        found = find_latest_snapshot(out, sig, ds)
+        assert found is not None and found[0] == 8
+
+
+# ---------------------------------------------------------------------------
+# SDC chaos soak (tools/soak_train.py sdc=1), tier-1 short variant
+# ---------------------------------------------------------------------------
+
+def test_soak_sdc_short():
+    sys.path.insert(0, os.path.join(HERE, "..", "tools"))
+    try:
+        import soak_train
+    finally:
+        sys.path.pop(0)
+    rep = soak_train.run_soak_train(rounds=8, n_rows=300, chaos=True,
+                                    sdc=True, budget_s=240.0)
+    assert rep["violations"] == [], rep
+    assert rep["n_trees"] == 8
+    assert rep["report"]["shrinks"] >= 1
+    assert {f["kind"] for f in rep["report"]["failures"]} == {"sdc"}
+    assert rep["integrity_metrics"]["integrity.sticky"] == 1
+    assert rep["integrity_metrics"]["integrity.transient_absorbed"] >= 2
+    assert os.path.exists(
+        os.path.join(rep["workdir"], "soak_model.txt.elastic.jsonl"))

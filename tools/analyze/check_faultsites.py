@@ -1,0 +1,148 @@
+"""Fault-site coverage lint: every declared injection site is wired and
+exercised.
+
+``utils/faultinject.KNOWN_SITES`` is the registry of chaos-injection
+points (docs/Fault-Tolerance.md).  A site that exists in the registry
+but is never reached by a test or soak is worse than no site at all:
+the fault-tolerance story CLAIMS coverage the suite does not deliver,
+and the site's wiring silently rots.  This lint keeps the registry
+honest, grep-verifiably:
+
+- **unwired**  — the site name never appears in a string literal of
+  any package module besides ``utils/faultinject.py`` itself: nothing
+  can ever fire it;
+- **unexercised** — the site name never appears in a string literal
+  under ``tests/`` or ``tools/`` (spec strings like ``"hist_sdc:3-5"``
+  count — that is exactly how sites are armed), so no test or soak
+  drives it.  Pinnable in ``tools/faultsite_allowlist.txt`` with a
+  MANDATORY rationale;
+- **stale pins** — allowlist entries for sites that are now exercised
+  (or no longer declared) are findings, so the allowlist cannot rot.
+
+Matching is over tokenized STRING literals only (site names live in
+strings: configure specs, ``fires(...)``/``maybe_bitflip(...)`` calls),
+so comments never satisfy the lint.  Run via the unified driver
+(``python tools/lint.py``; tier-1) or standalone
+(``python tools/analyze/check_faultsites.py``; exit 1 on findings).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import sys
+import tokenize
+from typing import Iterator, List, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import lintlib                                           # noqa: E402
+
+REPO = lintlib.REPO
+ALLOWLIST = os.path.join(REPO, "tools", "faultsite_allowlist.txt")
+_REGISTRY_REL = os.path.join("utils", "faultinject.py")
+
+
+def declared_sites(package_root: str = lintlib.PACKAGE) -> Tuple[str, ...]:
+    """``KNOWN_SITES`` parsed out of the package's faultinject module —
+    textually (AST + literal_eval), so a ``--package-root`` copy is
+    linted without importing it."""
+    path = os.path.join(package_root, _REGISTRY_REL)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                for t in node.targets):
+            return tuple(ast.literal_eval(node.value))
+    raise ValueError(f"{path}: no KNOWN_SITES assignment found")
+
+
+def _string_literals(path: str) -> Iterator[str]:
+    with open(path, "rb") as f:
+        src = f.read()
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if tok.type == tokenize.STRING:
+                yield tok.string
+    except tokenize.TokenError:
+        pass                     # partial file: lint what parsed
+
+
+def _sites_in_tree(roots: List[str], sites: Tuple[str, ...],
+                   skip: Set[str]) -> Set[str]:
+    pats = {s: re.compile(rf"\b{re.escape(s)}\b") for s in sites}
+    found: Set[str] = set()
+    for root in roots:
+        for path in lintlib.iter_py(root):
+            if os.path.abspath(path) in skip:
+                continue
+            for lit in _string_literals(path):
+                for s, pat in pats.items():
+                    if s not in found and pat.search(lit):
+                        found.add(s)
+            if len(found) == len(sites):
+                return found
+    return found
+
+
+def run(package_root: str = lintlib.PACKAGE,
+        allowlist_path: str = ALLOWLIST) -> List[str]:
+    """All coverage findings (empty list = lint green)."""
+    sites = declared_sites(package_root)
+    findings: List[str] = []
+    dupes = sorted({s for s in sites if sites.count(s) > 1})
+    if dupes:
+        findings.append("duplicate KNOWN_SITES entries: "
+                        + ", ".join(dupes))
+
+    registry = os.path.abspath(
+        os.path.join(package_root, _REGISTRY_REL))
+    wired = _sites_in_tree([package_root], sites, skip={registry})
+    # this lint (site names in its own docstring/strings) and its
+    # allowlist never count as exercise
+    me = os.path.abspath(__file__)
+    exercised = _sites_in_tree(
+        [os.path.join(REPO, "tests"), os.path.join(REPO, "tools")],
+        sites, skip={me})
+
+    allow = {key[0] for key, _ in lintlib.parse_pins(
+        allowlist_path, 1, require_rationale=True)}
+    used: Set[str] = set()
+    for s in sites:
+        if s not in wired:
+            findings.append(
+                f"declared but UNWIRED site '{s}': no package module "
+                "references it (utils/faultinject.py aside) — nothing "
+                "can ever fire it")
+        if s not in exercised:
+            if s in allow:
+                used.add(s)
+            else:
+                findings.append(
+                    f"declared but UNEXERCISED site '{s}': no test or "
+                    "soak under tests/ or tools/ arms it")
+    for s in sorted(allow - set(sites)):
+        findings.append(f"stale allowlist entry: site '{s}' is no "
+                        "longer declared in KNOWN_SITES")
+    findings.extend(lintlib.stale_pins(
+        {(s,) for s in allow & set(sites)}, {(s,) for s in used},
+        "faultsite allowlist"))
+    return findings
+
+
+def main() -> int:
+    findings = run()
+    if findings:
+        print(f"{len(findings)} fault-site coverage finding(s):",
+              file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("fault-site coverage clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

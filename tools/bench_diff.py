@@ -57,7 +57,7 @@ _LOWER_BETTER = re.compile(
     r"(_ms|compile_s|_seconds|_lag_s|_gen_s|_hbm_bytes_per_iter"
     r"|_ms_per_pass|_ms_per_leaf(_k\d+|_wide)?"
     r"|_sync(s|_count)_per_iter"
-    r"|_peak_rss_mb|_wire_bytes)$")
+    r"|_peak_rss_mb|_wire_bytes|_overhead_pct)$")
 # extras worth gating by default: primary value, throughput points,
 # serve latency/throughput (host-accumulation AND fused device paths),
 # mfu, the continual pipeline's freshness numbers, and the histogram
@@ -84,7 +84,11 @@ _GATEABLE = re.compile(
     # throughput, the bounded-memory subprocess RSS, and the
     # sketch-allgather wire bytes
     r"|^ingest_(rows_per_s|peak_rss_mb)$"
-    r"|^binning_wire_bytes$)")
+    r"|^binning_wire_bytes$"
+    # integrity layer (ISSUE 20, lightgbm_tpu/integrity.py): the
+    # measured cost of integrity_check_freq=16 over an unchecked run —
+    # the "pay only on check iterations" contract as a gated number
+    r"|^integrity_overhead_pct$)")
 _DEFAULT_TOL = {"higher": 0.20, "lower": 0.30}
 
 

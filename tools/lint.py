@@ -10,6 +10,10 @@ Runs the whole lint family (docs/Static-Analysis.md) over the tree:
   tools/purity_allowlist.txt)
 - **syncs**    — raw host-sync lint (tools/check_syncs.py;
   tools/sync_allowlist.txt)
+- **faultsites** — fault-injection-site coverage lint: every declared
+  ``utils/faultinject.KNOWN_SITES`` entry is wired in the package and
+  exercised by a test/soak (tools/analyze/check_faultsites.py;
+  tools/faultsite_allowlist.txt)
 - **retraces** — retrace-budget lint; runs the canonical training/serve
   matrix on CPU, so it costs ~15 s warm (tools/check_retraces.py;
   tools/retrace_budget.txt, the one pass ``--update`` re-pins)
@@ -38,9 +42,10 @@ from typing import Callable, Dict, List, Tuple
 
 TOOLS = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, TOOLS)
-from analyze import check_purity, check_races, lintlib   # noqa: E402
+from analyze import (check_faultsites, check_purity,     # noqa: E402
+                     check_races, lintlib)
 
-PASSES = ("races", "purity", "syncs", "retraces")
+PASSES = ("races", "purity", "syncs", "faultsites", "retraces")
 
 
 def main(argv=None) -> int:
@@ -104,6 +109,10 @@ def main(argv=None) -> int:
         "syncs": (run_syncs,
                   "route fences through obs.trace.fence, or pin in "
                   "tools/sync_allowlist.txt"),
+        "faultsites": (lambda: check_faultsites.run(root),
+                       "exercise the site from a test/soak, drop it "
+                       "from KNOWN_SITES, or pin with a rationale in "
+                       "tools/faultsite_allowlist.txt"),
         "retraces": (run_retraces,
                      "if intentional, re-pin with `python tools/lint.py"
                      " --only retraces --update`"),

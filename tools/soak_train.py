@@ -29,12 +29,23 @@ invariants the elastic layer promises (docs/Fault-Tolerance.md
   flight recorder (``telemetry_blackbox``) dumped on the classified
   failures.
 
+The ``sdc=1`` mode swaps the liveness chaos for SILENT-data-corruption
+chaos (lightgbm_tpu/integrity.py; docs/Fault-Tolerance.md layer 7):
+seeded single-bit flips at the ``hist_sdc``/``score_sdc`` sites put one
+TRANSIENT flip (re-check clean -> absorbed in place, no rewind) and one
+STICKY flip (fires again on the re-check -> classified ``sdc``, suspect
+device quarantined, ladder rewinds to the newest integrity-VERIFIED
+snapshot) into a single run — which must still end byte-identical to an
+uninjected reference.
+
 Run standalone (prints one JSON report, exit 1 on violations)::
 
     python tools/soak_train.py rounds=16 mesh=4 chaos=1
+    python tools/soak_train.py rounds=12 sdc=1
 
 Importable: ``run_soak_train(...)`` returns the report dict —
-``tests/test_zelastic.py`` runs a short deterministic soak in tier-1.
+``tests/test_zelastic.py`` (liveness) and ``tests/test_integrity.py``
+(sdc) each run a short deterministic soak in tier-1.
 """
 
 from __future__ import annotations
@@ -68,15 +79,19 @@ def run_soak_train(rounds: int = 12, n_rows: int = 400, mesh: int = 4,
                    quant: bool = True, workdir: Optional[str] = None,
                    hang_s: float = 6.0,
                    collective_timeout_s: float = 1.0,
-                   budget_s: float = 300.0,
+                   budget_s: float = 300.0, sdc: bool = False,
                    params: Optional[Dict] = None) -> Dict:
     """One elastic-training soak; returns the report dict (module
     docstring).  ``chaos=False`` is the control arm: same config, no
     faults — must complete with zero shrinks and the same final model.
+    ``sdc=True`` runs the silent-data-corruption arm instead: serial
+    masked learner under the elastic ladder, one transient + one sticky
+    bit flip, ``integrity_policy=quarantine``.
     """
     import tempfile
 
     from lightgbm_tpu import Dataset, train as engine_train
+    from lightgbm_tpu import integrity
     from lightgbm_tpu.metrics import _auc
     from lightgbm_tpu.parallel import elastic
     from lightgbm_tpu.utils import faultinject
@@ -99,6 +114,16 @@ def run_soak_train(rounds: int = 12, n_rows: int = 400, mesh: int = 4,
          "dist_init_timeout_s": float(collective_timeout_s),
          "dist_init_retries": 0,
          "telemetry_blackbox": True}
+    if sdc:
+        # SDC arm: serial masked learner (the integrity layer's shadow
+        # grower is an independent trace there), every iteration
+        # shadow-checked, sticky failures quarantined so the ladder —
+        # not engine.train's own rewind loop — drives the recovery
+        p.pop("tree_learner", None)
+        p.pop("mesh_shape", None)
+        p["tpu_learner"] = "masked"
+        p["integrity_check_freq"] = 1
+        p["integrity_policy"] = "quarantine"
     p.update(params or {})
 
     # uninterrupted SERIAL oracle over the same data — the parity
@@ -112,11 +137,22 @@ def run_soak_train(rounds: int = 12, n_rows: int = 400, mesh: int = 4,
                        num_boost_round=rounds)
 
     violations = []
-    spec = chaos_spec or ("collective_hang:4,claim_wedge:2,host_loss:8"
-                          if chaos else None)
+    if sdc:
+        # one TRANSIENT (score gather, iteration 3: fires once, the
+        # re-check hit does not -> absorbed) and one STICKY window
+        # (histogram, 3 consecutive hits: fire + re-check fire ->
+        # sticky -> ladder rewind, then the replay's fire re-checks
+        # clean -> absorbed) in a single run
+        s0 = max(4, int(rounds) - 5)
+        spec = chaos_spec or (f"score_sdc:3,hist_sdc:{s0}-{s0 + 2}"
+                              if chaos else None)
+    else:
+        spec = chaos_spec or ("collective_hang:4,claim_wedge:2,"
+                              "host_loss:8" if chaos else None)
     prev_hang = os.environ.get(faultinject.HANG_ENV_VAR)
     os.environ[faultinject.HANG_ENV_VAR] = str(hang_s)
     elastic.reset_metrics()
+    integrity.reset_metrics()
     t0 = time.monotonic()
     try:
         faultinject.configure(spec)
@@ -157,6 +193,9 @@ def run_soak_train(rounds: int = 12, n_rows: int = 400, mesh: int = 4,
         violations.append(
             f"metric parity failed: soak auc {auc_got:.6f} vs "
             f"serial {auc_ref:.6f}")
+    int_metrics = {k: v.get("value")
+                   for k, v in integrity.metrics_snapshot().items()
+                   if v.get("type") != "histogram"}
     if chaos:
         if report.get("shrinks", 0) < 1:
             violations.append("chaos run finished without a mesh shrink")
@@ -165,6 +204,24 @@ def run_soak_train(rounds: int = 12, n_rows: int = 400, mesh: int = 4,
         kinds = {f["kind"] for f in report.get("failures", ())}
         if not kinds:
             violations.append("no classified failures recorded")
+        if sdc:
+            if kinds != {"sdc"}:
+                violations.append(
+                    f"expected only classified 'sdc' failures, got {kinds}")
+            if int_metrics.get("integrity.sticky", 0) != 1:
+                violations.append(
+                    "exactly one sticky SDC expected, got "
+                    f"{int_metrics.get('integrity.sticky', 0)}")
+            if int_metrics.get("integrity.transient_absorbed", 0) < 2:
+                violations.append(
+                    "transient SDCs (score @3 + post-rewind replay) were "
+                    "not absorbed in place: "
+                    f"{int_metrics.get('integrity.transient_absorbed', 0)}")
+            if int_metrics.get("integrity.quarantined", 0) < 1:
+                violations.append("sticky SDC did not quarantine a device")
+            if not elastic.suspected_devices():
+                violations.append("no suspect device recorded after the "
+                                  "sticky SDC")
         if not any(k.startswith("elastic.failures")
                    for k in metrics):
             violations.append("elastic.failures metrics missing")
@@ -186,6 +243,7 @@ def run_soak_train(rounds: int = 12, n_rows: int = 400, mesh: int = 4,
             "elastic_metrics": {k: v.get("value")
                                 for k, v in metrics.items()
                                 if v.get("type") != "histogram"},
+            "integrity_metrics": int_metrics,
             "workdir": workdir}
 
 
@@ -208,7 +266,8 @@ def main(argv) -> int:
         chaos=kv.get("chaos", "1") not in ("0", "false"),
         quant=kv.get("quant", "1") not in ("0", "false"),
         hang_s=float(kv.get("hang_s", 6.0)),
-        budget_s=float(kv.get("budget_s", 300.0)))
+        budget_s=float(kv.get("budget_s", 300.0)),
+        sdc=kv.get("sdc", "0") not in ("0", "false"))
     print(json.dumps(rep, indent=1, sort_keys=True))
     return 1 if rep["violations"] else 0
 
